@@ -1,0 +1,24 @@
+"""cruise_control_tpu — a TPU-native cluster-workload balancing framework.
+
+A brand-new implementation of the capabilities of Kafka Cruise Control
+(reference: viktorsomogyi/cruise-control), redesigned TPU-first:
+
+- The cluster workload model is a struct-of-arrays tensor pytree
+  (``model.TensorClusterModel``) instead of a JVM object graph
+  (reference: cruise-control/src/main/java/.../model/ClusterModel.java:46).
+- Goals are pure vectorized ``(cost, feasibility, acceptance)`` functions
+  (reference: analyzer/goals/Goal.java:39) and the optimizer scores tens of
+  thousands of candidate balancing actions per step on the MXU via jit/vmap
+  instead of iterating replica-by-replica (reference:
+  analyzer/goals/AbstractGoal.java:82).
+- Multi-chip scaling uses a jax.sharding.Mesh + collectives over ICI, not
+  thread pools.
+
+Subpackages mirror the reference's layer map (SURVEY.md §1):
+``monitor`` (sampling/aggregation) → ``model`` (cluster model) →
+``analyzer`` (goals + optimizer) → ``executor`` (movement execution) →
+``detector`` (anomalies/self-healing) → ``api``/``client`` (REST/CLI),
+with ``ops``/``parallel`` holding the TPU kernels and sharding layer.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,130 @@
+"""Balancing actions, batched.
+
+The reference represents one action as a ``BalancingAction`` object
+(analyzer/BalancingAction.java:20) with an ``ActionType``
+(analyzer/ActionType.java:24-29) and applies them one at a time.  Here a
+*batch* of K candidate actions is a struct-of-arrays ``Candidates`` pytree
+carrying precomputed load/count deltas, so every goal can score and veto all
+K candidates with pure elementwise math — no per-action control flow.  The
+accepted subset is applied to the tensor model in one vectorized scatter.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+from jax import Array
+
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.model.tensor_model import TensorClusterModel
+
+
+class ActionType:
+    """Reference: analyzer/ActionType.java."""
+
+    INTER_BROKER_REPLICA_MOVEMENT = 0
+    LEADERSHIP_MOVEMENT = 1
+    INTRA_BROKER_REPLICA_MOVEMENT = 2
+
+
+class ActionAcceptance:
+    """Reference: analyzer/ActionAcceptance.java (ACCEPT / REPLICA_REJECT /
+    BROKER_REJECT).  In the batched path acceptance is a bool mask; the
+    tri-state is only used at the API edge."""
+
+    ACCEPT = "ACCEPT"
+    REPLICA_REJECT = "REPLICA_REJECT"
+    BROKER_REJECT = "BROKER_REJECT"
+
+
+@struct.dataclass
+class Candidates:
+    """K candidate actions with per-broker deltas (f32[K, 4] resource axes)."""
+
+    action_type: Array  # i32[K]
+    replica: Array  # i32[K] replica being moved / losing leadership
+    src: Array  # i32[K] source broker
+    dest: Array  # i32[K] destination broker
+    dest_replica: Array  # i32[K] replica gaining leadership (-1 for moves)
+    partition: Array  # i32[K]
+    valid: Array  # bool[K]
+    delta_src: Array  # f32[K, 4] load change on src broker (≤ 0 typically)
+    delta_dest: Array  # f32[K, 4] load change on dest broker
+    d_replica_count: Array  # i32[K] replicas leaving src / arriving dest
+    d_leader_count: Array  # i32[K] leaders leaving src / arriving dest
+    d_potential_nw_out: Array  # f32[K] potential NW_OUT moved src→dest
+    d_leader_bytes_in_src: Array  # f32[K] leader bytes-in removed from src
+    d_leader_bytes_in_dest: Array  # f32[K] leader bytes-in added to dest
+
+    @property
+    def k(self) -> int:
+        return self.action_type.shape[0]
+
+    def is_move(self) -> Array:
+        return self.action_type == ActionType.INTER_BROKER_REPLICA_MOVEMENT
+
+    def is_leadership(self) -> Array:
+        return self.action_type == ActionType.LEADERSHIP_MOVEMENT
+
+
+def make_candidates(model: TensorClusterModel, replica_ids: Array, dest_brokers: Array,
+                    action_type: Array, dest_replica: Array, valid: Array) -> Candidates:
+    """Assemble the delta fields for a K-batch of raw (replica, dest) picks.
+
+    For replica movement: src loses the replica's current load, dest gains it
+    (ClusterModel.relocateReplica semantics, ClusterModel.java:377-393).
+    For leadership movement: src loses (leader - follower) load of `replica`,
+    the dest replica's broker gains (leader - follower) of `dest_replica`
+    (Rack.makeLeader/makeFollower delta semantics, ClusterModel.java:406-431).
+    """
+    is_lead = action_type == ActionType.LEADERSHIP_MOVEMENT
+    r = replica_ids
+    r2 = jnp.where(dest_replica >= 0, dest_replica, 0)
+
+    src = model.replica_broker[r]
+    dest = jnp.where(is_lead, model.replica_broker[r2], dest_brokers)
+
+    cur_load = jnp.where(model.replica_is_leader[r][:, None],
+                         model.replica_load_leader[r], model.replica_load_follower[r])
+    lead_delta_src = model.replica_load_follower[r] - model.replica_load_leader[r]
+    lead_delta_dest = model.replica_load_leader[r2] - model.replica_load_follower[r2]
+
+    delta_src = jnp.where(is_lead[:, None], lead_delta_src, -cur_load)
+    delta_dest = jnp.where(is_lead[:, None], lead_delta_dest, cur_load)
+
+    is_leader_replica = model.replica_is_leader[r]
+    d_replica_count = jnp.where(is_lead, 0, 1).astype(jnp.int32)
+    d_leader_count = jnp.where(is_lead | is_leader_replica, 1, 0).astype(jnp.int32)
+    d_potential = jnp.where(is_lead, 0.0, model.replica_load_leader[r, Resource.NW_OUT])
+    leader_nw_in_r = model.replica_load_leader[r, Resource.NW_IN]
+    leader_nw_in_r2 = model.replica_load_leader[r2, Resource.NW_IN]
+    d_lbi_src = jnp.where(is_lead | is_leader_replica, leader_nw_in_r, 0.0)
+    d_lbi_dest = jnp.where(is_lead, leader_nw_in_r2,
+                           jnp.where(is_leader_replica, leader_nw_in_r, 0.0))
+
+    return Candidates(
+        action_type=action_type.astype(jnp.int32),
+        replica=r.astype(jnp.int32),
+        src=src.astype(jnp.int32),
+        dest=dest.astype(jnp.int32),
+        dest_replica=dest_replica.astype(jnp.int32),
+        partition=model.replica_partition[r],
+        valid=valid,
+        delta_src=delta_src,
+        delta_dest=delta_dest,
+        d_replica_count=d_replica_count,
+        d_leader_count=d_leader_count,
+        d_potential_nw_out=d_potential,
+        d_leader_bytes_in_src=d_lbi_src,
+        d_leader_bytes_in_dest=d_lbi_dest,
+    )
+
+
+def apply_candidates(model: TensorClusterModel, cand: Candidates, apply_mask: Array) -> TensorClusterModel:
+    """Apply the masked subset of candidates (moves then leaderships)."""
+    move_mask = apply_mask & cand.is_move()
+    model = model.relocate_replicas(cand.replica, cand.dest, move_mask)
+    lead_mask = apply_mask & cand.is_leadership()
+    safe_dest = jnp.where(cand.dest_replica >= 0, cand.dest_replica, cand.replica)
+    model = model.relocate_leadership(cand.replica, safe_dest, lead_mask)
+    return model

@@ -1,0 +1,434 @@
+"""Vectorized goal semantics.
+
+Every goal family from the reference (SURVEY.md §2.3) is implemented here as
+a set of pure functions over the tensor model + per-step broker aggregates:
+
+- ``broker_metric`` / ``limits`` / ``violated_brokers`` — the goal's
+  per-broker balance quantity and its [lower, upper] band (reference:
+  GoalUtils.computeResourceUtilizationBalanceThreshold and each goal's
+  ``initGoalState``).
+- ``self_feasible`` — may *this* goal apply a candidate while optimizing
+  itself (reference: ``selfSatisfied``, AbstractGoal.java:224-266).
+- ``accepts`` — would this goal, already optimized, veto the candidate
+  (reference: ``actionAcceptance``, Goal.java:39; evaluated for all
+  previously-optimized goals at AnalyzerUtils.java:117).
+- ``score`` — improvement the candidate brings to this goal (the batched
+  generalization of the greedy accept-first-improvement loop: we score ALL
+  candidates and apply the best non-conflicting subset).
+- ``source_pressure`` / ``dest_room`` / ``source_replica_relevance`` —
+  candidate-generation hints replacing the reference's sorted-replica /
+  PriorityQueue broker selection (ResourceDistributionGoal.java:383-535).
+
+All functions are shape-polymorphic over K (candidate count) and compile to
+a single fused XLA graph per goal kind; ``GoalSpec`` fields are static.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+from cruise_control_tpu.analyzer.actions import ActionType, Candidates
+from cruise_control_tpu.analyzer.balancing_constraint import BALANCE_MARGIN, BalancingConstraint
+from cruise_control_tpu.analyzer.goals.specs import GoalSpec
+from cruise_control_tpu.analyzer.state import BrokerArrays
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.model.tensor_model import TensorClusterModel
+
+_BIG = 1e30
+_OFFLINE_BONUS = 1e12  # healing moves (offline replicas off dead brokers) dominate
+
+
+def _margin_pct(threshold: float) -> float:
+    """Margin-adjusted balance percentage (BalancingConstraint.balance_percentage
+    semantics applied to count/byte thresholds)."""
+    return (threshold - 1.0) * BALANCE_MARGIN + 1.0
+
+
+# ---------------------------------------------------------------------------
+# Per-broker metric and limits
+# ---------------------------------------------------------------------------
+
+def broker_metric(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
+                  constraint: BalancingConstraint) -> Array:
+    """f32[B] — the quantity the goal balances / caps."""
+    kind = spec.kind
+    if kind == "capacity" or kind == "resource_distribution":
+        return arrays.load[:, spec.resource]
+    if kind == "replica_capacity" or kind == "replica_distribution":
+        return arrays.replica_count.astype(jnp.float32)
+    if kind == "leader_replica_distribution":
+        return arrays.leader_count.astype(jnp.float32)
+    if kind == "potential_nw_out":
+        return arrays.potential_nw_out
+    if kind == "leader_bytes_in":
+        return arrays.leader_bytes_in
+    if kind in ("rack", "rack_distribution"):
+        # Number of rack-conflicted replicas hosted per broker.
+        conflict = _replica_rack_conflict(spec, model)
+        from cruise_control_tpu.ops.segment import masked_segment_count
+        return masked_segment_count(model.replica_broker, model.num_brokers,
+                                    model.replica_valid & conflict).astype(jnp.float32)
+    if kind == "topic_replica_distribution":
+        tbc = model.topic_broker_replica_counts().astype(jnp.float32)
+        lower_t, upper_t = _topic_limits(model, arrays, constraint)
+        excess = jnp.maximum(tbc - upper_t[:, None], 0.0) + jnp.maximum(lower_t[:, None] - tbc, 0.0)
+        return excess.sum(axis=0)
+    raise NotImplementedError(f"goal kind {kind}")
+
+
+def limits(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
+           constraint: BalancingConstraint):
+    """(lower f32[B], upper f32[B]) band for the goal metric."""
+    kind = spec.kind
+    B = arrays.load.shape[0]
+    zero = jnp.zeros((B,), jnp.float32)
+    if kind == "capacity":
+        upper = arrays.capacity[:, spec.resource] * constraint.capacity_threshold[spec.resource]
+        return zero, upper
+    if kind == "potential_nw_out":
+        upper = arrays.capacity[:, Resource.NW_OUT] * constraint.capacity_threshold[Resource.NW_OUT]
+        return zero, upper
+    if kind == "replica_capacity":
+        return zero, jnp.full((B,), float(constraint.max_replicas_per_broker), jnp.float32)
+    if kind == "resource_distribution":
+        res = spec.resource
+        bp = constraint.balance_percentage(res)
+        total_util = jnp.where(arrays.alive, arrays.load[:, res], 0.0).sum()
+        total_cap = jnp.maximum(jnp.where(arrays.alive, arrays.capacity[:, res], 0.0).sum(), 1e-9)
+        avg_pct = total_util / total_cap
+        # Low-utilization gating (ResourceDistributionGoal.initGoalState
+        # :238-281): below the threshold the cluster counts as balanced.
+        low = constraint.low_utilization_threshold[res]
+        gated = avg_pct <= low
+        upper = jnp.where(gated, _BIG, avg_pct * bp * arrays.capacity[:, res])
+        lower = jnp.where(gated, 0.0, avg_pct * (2.0 - bp) * arrays.capacity[:, res])
+        return jnp.maximum(lower, 0.0), upper
+    if kind == "replica_distribution":
+        bp = _margin_pct(constraint.replica_count_balance_threshold)
+        avg = jnp.where(arrays.alive, arrays.replica_count, 0).sum() / arrays.num_alive
+        return jnp.broadcast_to(jnp.floor(avg * (2.0 - bp)), (B,)), \
+            jnp.broadcast_to(jnp.ceil(avg * bp), (B,))
+    if kind == "leader_replica_distribution":
+        bp = _margin_pct(constraint.leader_replica_count_balance_threshold)
+        avg = jnp.where(arrays.alive, arrays.leader_count, 0).sum() / arrays.num_alive
+        return jnp.broadcast_to(jnp.floor(avg * (2.0 - bp)), (B,)), \
+            jnp.broadcast_to(jnp.ceil(avg * bp), (B,))
+    if kind == "leader_bytes_in":
+        bp = _margin_pct(constraint.resource_balance_threshold[Resource.NW_IN])
+        avg = jnp.where(arrays.alive, arrays.leader_bytes_in, 0.0).sum() / arrays.num_alive
+        # Cap-only goal: LeaderBytesInDistributionGoal balances the top end.
+        return zero, jnp.broadcast_to(avg * bp, (B,))
+    if kind in ("rack", "rack_distribution", "topic_replica_distribution"):
+        # Metric is a violation count/excess; the band is exactly zero.
+        return zero, zero
+    raise NotImplementedError(f"goal kind {kind}")
+
+
+def violated_brokers(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
+                     constraint: BalancingConstraint) -> Array:
+    """bool[B] brokers currently violating the goal (incl. dead brokers that
+    still host replicas — those must be healed by hard goals)."""
+    metric = broker_metric(spec, model, arrays, constraint)
+    lower, upper = limits(spec, model, arrays, constraint)
+    eps = _metric_epsilon(spec)
+    out_of_band = (metric > upper + eps) | (metric < lower - eps)
+    dead_with_replicas = (~arrays.alive) & arrays.valid & (arrays.replica_count > 0)
+    if spec.is_hard:
+        return (arrays.alive & out_of_band) | dead_with_replicas
+    return arrays.alive & out_of_band
+
+
+def goal_satisfied(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
+                   constraint: BalancingConstraint) -> Array:
+    return ~violated_brokers(spec, model, arrays, constraint).any()
+
+
+def _metric_epsilon(spec: GoalSpec) -> float:
+    if spec.kind in ("capacity", "resource_distribution"):
+        return Resource(spec.resource).epsilon * 1e-3
+    if spec.kind in ("potential_nw_out", "leader_bytes_in"):
+        return Resource.NW_OUT.epsilon * 1e-3
+    return 1e-6  # count-based metrics are integral
+
+
+# ---------------------------------------------------------------------------
+# Candidate metric deltas
+# ---------------------------------------------------------------------------
+
+def _candidate_deltas(spec: GoalSpec, cand: Candidates):
+    """(d_src f32[K], d_dest f32[K]) — change in the goal metric on the
+    source / destination broker if the candidate applies."""
+    kind = spec.kind
+    if kind in ("capacity", "resource_distribution"):
+        return cand.delta_src[:, spec.resource], cand.delta_dest[:, spec.resource]
+    if kind in ("replica_capacity", "replica_distribution"):
+        d = cand.d_replica_count.astype(jnp.float32)
+        return -d, d
+    if kind == "leader_replica_distribution":
+        d = cand.d_leader_count.astype(jnp.float32)
+        return -d, d
+    if kind == "potential_nw_out":
+        return -cand.d_potential_nw_out, cand.d_potential_nw_out
+    if kind == "leader_bytes_in":
+        return -cand.d_leader_bytes_in_src, cand.d_leader_bytes_in_dest
+    raise NotImplementedError(f"goal kind {kind}")
+
+
+# ---------------------------------------------------------------------------
+# Rack machinery
+# ---------------------------------------------------------------------------
+
+def _sibling_info(model: TensorClusterModel, replica_ids: Array):
+    """For each candidate replica: its siblings' replica ids / brokers /
+    racks (i32[K, max_rf]) with a validity mask excluding itself and pads."""
+    parts = model.replica_partition[replica_ids]
+    sib = model.partition_replicas[parts]  # i32[K, max_rf]
+    sib_valid = (sib >= 0) & (sib != replica_ids[:, None])
+    sib_safe = jnp.where(sib >= 0, sib, 0)
+    sib_broker = model.replica_broker[sib_safe]
+    sib_rack = model.broker_rack[sib_broker]
+    return sib, sib_broker, sib_rack, sib_valid
+
+
+def _replica_rack_conflict(spec: GoalSpec, model: TensorClusterModel) -> Array:
+    """bool[R] — replica violates rack placement.
+
+    ``rack`` (RackAwareGoal.java:33): a replica conflicts when a sibling of
+    its partition shares its rack; only the higher-id replica of each
+    conflicting pair is flagged (so one of the pair stays put).
+    ``rack_distribution`` (RackAwareDistributionGoal.java:65): a replica
+    conflicts when its rack hosts more than ceil(RF / num_racks) replicas of
+    the partition.
+    """
+    R = model.num_replicas_padded
+    r_idx = jnp.arange(R, dtype=jnp.int32)
+    sib, _, sib_rack, sib_valid = _sibling_info(model, r_idx)
+    own_rack = model.broker_rack[model.replica_broker]
+    same_rack = sib_valid & (sib_rack == own_rack[:, None])
+    if spec.kind == "rack":
+        conflict = (same_rack & (sib < r_idx[:, None])).any(axis=1)
+    else:
+        rf = model.partition_replication_factor()[model.replica_partition]
+        allowed = jnp.ceil(rf / model.num_racks)
+        # Keep the `allowed` lowest-id replicas per (partition, rack); any
+        # replica ranked at or past the quota is excess and must move.
+        rank_in_rack = (same_rack & (sib < r_idx[:, None])).sum(axis=1)
+        conflict = rank_in_rack >= allowed
+    return conflict & model.replica_valid
+
+
+def _move_rack_ok(spec: GoalSpec, model: TensorClusterModel, cand: Candidates) -> Array:
+    """bool[K] — replica move does not (re)create a rack violation."""
+    sib, _, sib_rack, sib_valid = _sibling_info(model, cand.replica)
+    dest_rack = model.broker_rack[cand.dest]
+    same_as_dest = sib_valid & (sib_rack == dest_rack[:, None])
+    if spec.kind == "rack":
+        return ~same_as_dest.any(axis=1)
+    rf = model.partition_replication_factor()[cand.partition]
+    allowed = jnp.ceil(rf / model.num_racks)
+    return (1 + same_as_dest.sum(axis=1)) <= allowed
+
+
+# ---------------------------------------------------------------------------
+# Feasibility / acceptance / score
+# ---------------------------------------------------------------------------
+
+def _src_unhealthy(model: TensorClusterModel, cand: Candidates, arrays: BrokerArrays) -> Array:
+    """Source broker dead or the replica itself offline — healing moves."""
+    return (~arrays.alive[cand.src]) | model.replica_offline[cand.replica]
+
+
+def self_feasible(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
+                  cand: Candidates, constraint: BalancingConstraint) -> Array:
+    """bool[K] — candidate is a legal self-improvement for this goal
+    (selfSatisfied + per-goal move eligibility)."""
+    kind = spec.kind
+    unhealthy = _src_unhealthy(model, cand, arrays)
+    if kind in ("rack", "rack_distribution"):
+        conflict = _replica_rack_conflict(spec, model)[cand.replica]
+        ok_dest = _move_rack_ok(spec, model, cand)
+        return cand.is_move() & (conflict | unhealthy) & ok_dest
+    if kind == "topic_replica_distribution":
+        lower_t, upper_t = _topic_limits(model, arrays, constraint)
+        tbc = model.topic_broker_replica_counts()
+        t = model.replica_topic[cand.replica]
+        c_src = tbc[t, cand.src].astype(jnp.float32)
+        c_dest = tbc[t, cand.dest].astype(jnp.float32)
+        up = upper_t[t]
+        lo = lower_t[t]
+        helps = (c_src > up) | (c_dest < lo) | unhealthy
+        stays = (c_dest + 1 <= up) & ((c_src - 1 >= lo) | unhealthy)
+        return cand.is_move() & helps & stays
+    metric = broker_metric(spec, model, arrays, constraint)
+    lower, upper = limits(spec, model, arrays, constraint)
+    d_src, d_dest = _candidate_deltas(spec, cand)
+    src_m, dest_m = metric[cand.src], metric[cand.dest]
+    src_after, dest_after = src_m + d_src, dest_m + d_dest
+    src_over = src_m > upper[cand.src]
+    dest_under = dest_m < lower[cand.dest]
+    helps = src_over | dest_under | unhealthy
+    dest_ok = dest_after <= upper[cand.dest]
+    src_ok = (src_after >= lower[cand.src]) | unhealthy
+    moves_something = jnp.abs(d_dest) > 0
+    return helps & dest_ok & src_ok & moves_something
+
+
+def accepts(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
+            cand: Candidates, constraint: BalancingConstraint) -> Array:
+    """bool[K] — this (already optimized) goal does not veto the candidate
+    (actionAcceptance; reference evaluates these for every previously
+    optimized goal before applying an action, AnalyzerUtils.java:117)."""
+    kind = spec.kind
+    if kind in ("rack", "rack_distribution"):
+        return jnp.where(cand.is_move(), _move_rack_ok(spec, model, cand), True)
+    if kind == "topic_replica_distribution":
+        lower_t, upper_t = _topic_limits(model, arrays, constraint)
+        tbc = model.topic_broker_replica_counts()
+        t = model.replica_topic[cand.replica]
+        c_src = tbc[t, cand.src].astype(jnp.float32)
+        c_dest = tbc[t, cand.dest].astype(jnp.float32)
+        ok = (c_dest + 1 <= upper_t[t]) & (c_src - 1 >= lower_t[t])
+        return jnp.where(cand.is_move(), ok, True)
+    metric = broker_metric(spec, model, arrays, constraint)
+    lower, upper = limits(spec, model, arrays, constraint)
+    d_src, d_dest = _candidate_deltas(spec, cand)
+    dest_after = metric[cand.dest] + d_dest
+    src_after = metric[cand.src] + d_src
+    dest_ok = (dest_after <= upper[cand.dest]) | (d_dest <= 0)
+    if spec.is_hard or kind in ("potential_nw_out", "leader_bytes_in"):
+        # Cap-style goals only bound the destination.
+        return dest_ok
+    src_ok = (src_after >= lower[cand.src]) | (d_src >= 0) | (~arrays.alive[cand.src])
+    return dest_ok & src_ok
+
+
+def score(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
+          cand: Candidates, constraint: BalancingConstraint) -> Array:
+    """f32[K] — improvement of the goal objective (higher is better; > 0
+    required to apply).  Healing moves get a dominating bonus so offline
+    replicas drain first (GoalUtils.ensureNoOfflineReplicas semantics)."""
+    kind = spec.kind
+    unhealthy = _src_unhealthy(model, cand, arrays)
+    bonus = jnp.where(unhealthy & cand.is_move(), _OFFLINE_BONUS, 0.0)
+    if kind in ("rack", "rack_distribution"):
+        sib, _, sib_rack, sib_valid = _sibling_info(model, cand.replica)
+        own_rack = model.broker_rack[cand.src]
+        dest_rack = model.broker_rack[cand.dest]
+        before = (sib_valid & (sib_rack == own_rack[:, None])).sum(axis=1)
+        after = (sib_valid & (sib_rack == dest_rack[:, None])).sum(axis=1)
+        return (before - after).astype(jnp.float32) + bonus
+    if kind == "topic_replica_distribution":
+        tbc = model.topic_broker_replica_counts().astype(jnp.float32)
+        t = model.replica_topic[cand.replica]
+        avg_t = _topic_avg(model, arrays)[t]
+        c_src = tbc[t, cand.src]
+        c_dest = tbc[t, cand.dest]
+        before = (c_src - avg_t) ** 2 + (c_dest - avg_t) ** 2
+        after = (c_src - 1 - avg_t) ** 2 + (c_dest + 1 - avg_t) ** 2
+        return (before - after) + bonus
+    metric = broker_metric(spec, model, arrays, constraint)
+    lower, upper = limits(spec, model, arrays, constraint)
+    d_src, d_dest = _candidate_deltas(spec, cand)
+    src_m, dest_m = metric[cand.src], metric[cand.dest]
+    if kind in ("capacity", "potential_nw_out", "replica_capacity"):
+        # Threshold goals: reduction in total excess over the cap.
+        def excess(m, b):
+            return jnp.maximum(m - upper[b], 0.0)
+        before = excess(src_m, cand.src) + excess(dest_m, cand.dest)
+        after = excess(src_m + d_src, cand.src) + excess(dest_m + d_dest, cand.dest)
+        return before - after + bonus
+    # Distribution goals: reduction in squared deviation from the per-broker
+    # target (mean utilization scaled to broker capacity).
+    target = (lower + upper) * 0.5
+    target = jnp.where(upper >= _BIG, metric, target)  # gated: no preference
+    before = (src_m - target[cand.src]) ** 2 + (dest_m - target[cand.dest]) ** 2
+    after = (src_m + d_src - target[cand.src]) ** 2 + (dest_m + d_dest - target[cand.dest]) ** 2
+    return before - after + bonus
+
+
+# ---------------------------------------------------------------------------
+# Candidate-generation hints
+# ---------------------------------------------------------------------------
+
+def source_pressure(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
+                    constraint: BalancingConstraint) -> Array:
+    """f32[B] — how urgently each broker needs to shed (goal metric above
+    upper limit; dead brokers get a dominating value)."""
+    metric = broker_metric(spec, model, arrays, constraint)
+    lower, upper = limits(spec, model, arrays, constraint)
+    over = jnp.maximum(metric - upper, 0.0)
+    scale = jnp.maximum(jnp.abs(upper), 1.0)
+    pressure = over / scale
+    dead = (~arrays.alive) & arrays.valid & (arrays.replica_count > 0)
+    return jnp.where(dead, _BIG, jnp.where(arrays.valid, pressure, -_BIG))
+
+
+def dest_room(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
+              constraint: BalancingConstraint) -> Array:
+    """f32[B] — headroom under the goal's upper limit (candidate dests)."""
+    metric = broker_metric(spec, model, arrays, constraint)
+    lower, upper = limits(spec, model, arrays, constraint)
+    room = jnp.minimum(upper, _BIG) - metric
+    # Prefer brokers below the lower limit (they *need* load).
+    room = room + jnp.maximum(lower - metric, 0.0) * 10.0
+    return jnp.where(arrays.alive, room, -_BIG)
+
+
+def source_replica_relevance(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
+                             constraint: BalancingConstraint) -> Array:
+    """f32[R] — ranking for choosing which replicas to propose moving.
+    Combines source-broker pressure with a per-replica tiebreak (bigger
+    replicas first, mirroring the reference's load-sorted candidate replicas
+    via SortedReplicas, model/SortedReplicas.java:47)."""
+    pressure = source_pressure(spec, model, arrays, constraint)[model.replica_broker]
+    kind = spec.kind
+    if kind in ("rack", "rack_distribution"):
+        conflict = _replica_rack_conflict(spec, model)
+        base = jnp.where(conflict, 1.0, -_BIG)
+    elif kind == "topic_replica_distribution":
+        lower_t, upper_t = _topic_limits(model, arrays, constraint)
+        tbc = model.topic_broker_replica_counts().astype(jnp.float32)
+        c = tbc[model.replica_topic, model.replica_broker]
+        base = jnp.where(c > upper_t[model.replica_topic], 1.0 + pressure, -_BIG)
+    else:
+        relevant = pressure > 0
+        if kind in ("leader_replica_distribution", "leader_bytes_in"):
+            relevant = relevant & model.replica_is_leader
+        tiebreak = _replica_metric_contribution(spec, model)
+        scale = jnp.maximum(jnp.abs(tiebreak).max(), 1e-9)
+        base = jnp.where(relevant, pressure + 1e-3 * tiebreak / scale, -_BIG)
+    offline = model.replica_offline | (~arrays.alive[model.replica_broker])
+    base = jnp.where(offline, _BIG, base)
+    return jnp.where(model.replica_valid, base, -_BIG)
+
+
+def _replica_metric_contribution(spec: GoalSpec, model: TensorClusterModel) -> Array:
+    """f32[R] — each replica's contribution to the goal metric."""
+    kind = spec.kind
+    load = model.replica_load()
+    if kind in ("capacity", "resource_distribution"):
+        return load[:, spec.resource]
+    if kind == "potential_nw_out":
+        return model.replica_load_leader[:, Resource.NW_OUT]
+    if kind == "leader_bytes_in":
+        return jnp.where(model.replica_is_leader, model.replica_load_leader[:, Resource.NW_IN], 0.0)
+    return jnp.ones(load.shape[0], jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Topic-level helpers (TopicReplicaDistributionGoal.java:58)
+# ---------------------------------------------------------------------------
+
+def _topic_avg(model: TensorClusterModel, arrays: BrokerArrays) -> Array:
+    from cruise_control_tpu.ops.segment import masked_segment_count
+    totals = masked_segment_count(model.replica_topic, model.num_topics,
+                                  model.replica_valid).astype(jnp.float32)
+    return totals / arrays.num_alive
+
+
+def _topic_limits(model: TensorClusterModel, arrays: BrokerArrays,
+                  constraint: BalancingConstraint):
+    bp = _margin_pct(constraint.topic_replica_count_balance_threshold)
+    avg = _topic_avg(model, arrays)
+    return jnp.floor(avg * (2.0 - bp)), jnp.ceil(avg * bp)

@@ -1,0 +1,88 @@
+"""Goal specifications.
+
+Each of the reference's goal classes (SURVEY.md §2.3, all 21 listed at
+analyzer/goals/*.java) is represented here as a small frozen ``GoalSpec``
+selecting a *kind* (the vectorized kernel family in ``kernels.py``) plus
+static parameters (resource binding, hardness).  This is the data-driven
+replacement for the reference's class-per-goal hierarchy rooted at
+``AbstractGoal`` (analyzer/goals/AbstractGoal.java:45): behavior lives in
+pure kernel functions; a spec is just the dispatch key, so a full goal list
+compiles to a handful of XLA graphs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+from cruise_control_tpu.common.resources import Resource
+
+
+@dataclasses.dataclass(frozen=True)
+class GoalSpec:
+    name: str
+    kind: str
+    is_hard: bool = False
+    resource: int = -1  # Resource id for resource-bound kinds
+
+    # Which action families the goal uses to improve itself.
+    uses_moves: bool = True
+    uses_leadership: bool = False
+
+
+def _capacity(name: str, resource: Resource) -> GoalSpec:
+    # Reference: goals/CapacityGoal.java:41 + resource bindings
+    # (CpuCapacityGoal.java:12, DiskCapacityGoal, NetworkIn/OutboundCapacityGoal).
+    return GoalSpec(name=name, kind="capacity", is_hard=True, resource=int(resource),
+                    uses_moves=True, uses_leadership=resource in (Resource.CPU, Resource.NW_OUT))
+
+
+def _distribution(name: str, resource: Resource) -> GoalSpec:
+    # Reference: goals/ResourceDistributionGoal.java:55 + bindings.
+    return GoalSpec(name=name, kind="resource_distribution", is_hard=False, resource=int(resource),
+                    uses_moves=True, uses_leadership=resource in (Resource.CPU, Resource.NW_OUT))
+
+
+GOAL_SPECS: Dict[str, GoalSpec] = {
+    "RackAwareGoal": GoalSpec("RackAwareGoal", "rack", is_hard=True),
+    # Relaxed rack distribution (goals/RackAwareDistributionGoal.java:65):
+    # same kernel family with even-distribution limits.
+    "RackAwareDistributionGoal": GoalSpec("RackAwareDistributionGoal", "rack_distribution",
+                                          is_hard=True),
+    "ReplicaCapacityGoal": GoalSpec("ReplicaCapacityGoal", "replica_capacity", is_hard=True),
+    "DiskCapacityGoal": _capacity("DiskCapacityGoal", Resource.DISK),
+    "NetworkInboundCapacityGoal": _capacity("NetworkInboundCapacityGoal", Resource.NW_IN),
+    "NetworkOutboundCapacityGoal": _capacity("NetworkOutboundCapacityGoal", Resource.NW_OUT),
+    "CpuCapacityGoal": _capacity("CpuCapacityGoal", Resource.CPU),
+    "ReplicaDistributionGoal": GoalSpec("ReplicaDistributionGoal", "replica_distribution"),
+    "PotentialNwOutGoal": GoalSpec("PotentialNwOutGoal", "potential_nw_out"),
+    "DiskUsageDistributionGoal": _distribution("DiskUsageDistributionGoal", Resource.DISK),
+    "NetworkInboundUsageDistributionGoal": _distribution(
+        "NetworkInboundUsageDistributionGoal", Resource.NW_IN),
+    "NetworkOutboundUsageDistributionGoal": _distribution(
+        "NetworkOutboundUsageDistributionGoal", Resource.NW_OUT),
+    "CpuUsageDistributionGoal": _distribution("CpuUsageDistributionGoal", Resource.CPU),
+    "TopicReplicaDistributionGoal": GoalSpec("TopicReplicaDistributionGoal",
+                                             "topic_replica_distribution"),
+    "LeaderReplicaDistributionGoal": GoalSpec("LeaderReplicaDistributionGoal",
+                                              "leader_replica_distribution",
+                                              uses_moves=True, uses_leadership=True),
+    "LeaderBytesInDistributionGoal": GoalSpec("LeaderBytesInDistributionGoal",
+                                              "leader_bytes_in", uses_moves=False,
+                                              uses_leadership=True),
+    # PreferredLeaderElectionGoal, MinTopicLeadersPerBrokerGoal and the
+    # kafka-assigner modes are added together with their kernels; the registry
+    # only advertises goals whose kernel families exist.
+}
+
+
+def goals_by_priority(names: Sequence[str]) -> List[GoalSpec]:
+    """Resolve goal names (short or fully qualified) in priority order
+    (KafkaCruiseControlUtils.goalsByPriority analogue)."""
+    out = []
+    for name in names:
+        short = name.rsplit(".", 1)[-1]
+        if short not in GOAL_SPECS:
+            raise ValueError(f"Unknown goal {name!r}")
+        out.append(GOAL_SPECS[short])
+    return out

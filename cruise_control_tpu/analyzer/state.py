@@ -1,0 +1,70 @@
+"""Per-step broker aggregate bundle.
+
+All goal kernels consume these aggregates instead of touching the replica
+axis; they are recomputed once per optimizer step (one fused scatter pass
+over R) and gathered per candidate.  This replaces the reference's
+incrementally-maintained per-object accumulators (Broker/Host/Rack load
+fields) with recompute-on-step — cheaper on TPU than fine-grained updates,
+and trivially correct.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+from jax import Array
+
+from cruise_control_tpu.model.tensor_model import TensorClusterModel
+
+
+@struct.dataclass
+class BrokerArrays:
+    load: Array  # f32[B, 4]
+    replica_count: Array  # i32[B]
+    leader_count: Array  # i32[B]
+    potential_nw_out: Array  # f32[B]
+    leader_bytes_in: Array  # f32[B]
+    alive: Array  # bool[B]
+    capacity: Array  # f32[B, 4]
+    valid: Array  # bool[B]
+    num_alive: Array  # i32 scalar
+
+    @classmethod
+    def from_model(cls, model: TensorClusterModel) -> "BrokerArrays":
+        alive = model.alive_broker_mask()
+        return cls(
+            load=model.broker_load(),
+            replica_count=model.broker_replica_counts(),
+            leader_count=model.broker_leader_counts(),
+            potential_nw_out=model.potential_leadership_load(),
+            leader_bytes_in=model.broker_leader_bytes_in(),
+            alive=alive,
+            capacity=model.broker_capacity,
+            valid=model.broker_valid,
+            num_alive=jnp.maximum(alive.sum(), 1),
+        )
+
+
+@struct.dataclass
+class OptimizationOptions:
+    """Traced per-request constraints (analyzer/OptimizationOptions.java:16).
+
+    Arrays so that changing exclusions does not trigger recompilation.
+    """
+
+    topic_excluded: Array  # bool[T] excluded from partition movement
+    broker_excluded_replica_move: Array  # bool[B] may not *receive* replicas
+    broker_excluded_leadership: Array  # bool[B] may not *receive* leadership
+    requested_dest_only: Array  # bool[B] — if any set, moves must land on these
+    only_move_immigrants: Array  # bool scalar
+
+    @classmethod
+    def none(cls, model: TensorClusterModel) -> "OptimizationOptions":
+        B = model.num_brokers
+        return cls(
+            topic_excluded=jnp.zeros((model.num_topics,), bool),
+            broker_excluded_replica_move=jnp.zeros((B,), bool),
+            broker_excluded_leadership=jnp.zeros((B,), bool),
+            requested_dest_only=jnp.zeros((B,), bool),
+            only_move_immigrants=jnp.zeros((), bool),
+        )

@@ -1,0 +1,3 @@
+from cruise_control_tpu.common.resources import Resource, NUM_RESOURCES
+
+__all__ = ["Resource", "NUM_RESOURCES"]

@@ -1,0 +1,18 @@
+from cruise_control_tpu.model.tensor_model import (
+    BrokerState,
+    TensorClusterModel,
+    build_model,
+)
+from cruise_control_tpu.model.stats import ClusterModelStats, compute_stats
+from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster, small_deterministic_cluster
+
+__all__ = [
+    "BrokerState",
+    "TensorClusterModel",
+    "build_model",
+    "ClusterModelStats",
+    "compute_stats",
+    "ClusterSpec",
+    "generate_cluster",
+    "small_deterministic_cluster",
+]

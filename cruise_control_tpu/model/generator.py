@@ -1,0 +1,149 @@
+"""Random/deterministic synthetic cluster generators for tests and benchmarks.
+
+Parity with the reference's test fixtures: ``RandomCluster``
+(cruise-control/src/test/java/.../model/RandomCluster.java — random clusters
+with uniform/linear/exponential replica distributions) and
+``DeterministicCluster`` (test/java/.../common/DeterministicCluster.java —
+small hand-crafted models).  These drive the OptimizationVerifier-style
+property tests and the benchmark ladder in BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import numpy as np
+
+from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
+from cruise_control_tpu.model.tensor_model import TensorClusterModel, build_model
+
+
+@dataclasses.dataclass
+class ClusterSpec:
+    """Knobs mirroring the reference's TestConstants/ClusterProperty maps."""
+
+    num_brokers: int = 3
+    num_racks: int = 3
+    num_topics: int = 5
+    mean_partitions_per_topic: float = 20.0
+    replication_factor: int = 2
+    distribution: str = "uniform"  # uniform | linear | exponential
+    # Mean per-partition leader loads (CPU %, NW_IN KB/s, NW_OUT KB/s, DISK MB)
+    mean_cpu: float = 0.1
+    mean_nw_in: float = 100.0
+    mean_nw_out: float = 100.0
+    mean_disk: float = 100.0
+    # Broker capacities
+    cpu_capacity: float = 100.0
+    nw_in_capacity: float = 200000.0
+    nw_out_capacity: float = 200000.0
+    disk_capacity: float = 1000000.0
+    seed: int = 0
+
+
+def generate_cluster(spec: ClusterSpec, pad_replicas_to: Optional[int] = None) -> TensorClusterModel:
+    """Generate a random cluster whose replicas are placed randomly (possibly
+    skewed), so distribution goals have work to do."""
+    rng = np.random.default_rng(spec.seed)
+    B = spec.num_brokers
+    rf = spec.replication_factor
+    assert spec.num_racks <= B
+
+    # Topics and partition counts.
+    parts_per_topic = np.maximum(
+        1, rng.poisson(spec.mean_partitions_per_topic, size=spec.num_topics))
+    P = int(parts_per_topic.sum())
+    partition_topic = np.repeat(np.arange(spec.num_topics, dtype=np.int32), parts_per_topic)
+
+    # Placement skew: weight brokers per the chosen distribution so the
+    # initial state is unbalanced (like RandomCluster's populate()).
+    if spec.distribution == "uniform":
+        weights = np.ones(B)
+    elif spec.distribution == "linear":
+        weights = np.arange(1, B + 1, dtype=np.float64)
+    elif spec.distribution == "exponential":
+        weights = np.exp(np.linspace(0.0, 3.0, B))
+    else:
+        raise ValueError(f"unknown distribution {spec.distribution!r}")
+    weights = weights / weights.sum()
+
+    R = P * rf
+    replica_partition = np.repeat(np.arange(P, dtype=np.int32), rf)
+    replica_topic = partition_topic[replica_partition]
+    replica_is_leader = (np.arange(R) % rf) == 0
+
+    # Choose rf distinct brokers per partition, weighted.
+    replica_broker = np.empty(R, np.int32)
+    for p in range(P):
+        chosen = rng.choice(B, size=rf, replace=False, p=weights)
+        replica_broker[p * rf:(p + 1) * rf] = chosen
+
+    # Per-partition loads; leader carries NW_OUT + leadership CPU, follower
+    # carries replication NW_IN and a CPU fraction (reference:
+    # ModelUtils.getFollowerCpuUtilFromLeaderLoad, model/ModelUtils.java:61).
+    leader_load = np.empty((P, NUM_RESOURCES), np.float32)
+    leader_load[:, Resource.CPU] = rng.exponential(spec.mean_cpu, P)
+    leader_load[:, Resource.NW_IN] = rng.exponential(spec.mean_nw_in, P)
+    leader_load[:, Resource.NW_OUT] = rng.exponential(spec.mean_nw_out, P)
+    leader_load[:, Resource.DISK] = rng.exponential(spec.mean_disk, P)
+
+    follower_load = leader_load.copy()
+    follower_load[:, Resource.NW_OUT] = 0.0
+    follower_load[:, Resource.CPU] *= 0.4  # follower CPU fraction heuristic
+
+    replica_load_leader = leader_load[replica_partition]
+    replica_load_follower = follower_load[replica_partition]
+
+    broker_capacity = np.tile(
+        np.array([spec.cpu_capacity, spec.nw_in_capacity, spec.nw_out_capacity,
+                  spec.disk_capacity], np.float32), (B, 1))
+    broker_rack = (np.arange(B) % spec.num_racks).astype(np.int32)
+
+    return build_model(
+        replica_broker=replica_broker,
+        replica_partition=replica_partition,
+        replica_topic=replica_topic,
+        replica_is_leader=replica_is_leader,
+        replica_load_leader=replica_load_leader,
+        replica_load_follower=replica_load_follower,
+        broker_capacity=broker_capacity,
+        broker_rack=broker_rack,
+        partition_topic=partition_topic,
+        pad_replicas_to=pad_replicas_to,
+    )
+
+
+def small_deterministic_cluster() -> TensorClusterModel:
+    """A tiny 3-broker / 2-topic hand-crafted model, analogous to the
+    reference's DeterministicCluster fixtures: broker 0 heavily loaded,
+    broker 2 nearly empty."""
+    # topic 0: partitions 0..2 rf=2; topic 1: partitions 3..4 rf=2
+    replica_partition = np.array([0, 0, 1, 1, 2, 2, 3, 3, 4, 4], np.int32)
+    replica_topic = np.array([0, 0, 0, 0, 0, 0, 1, 1, 1, 1], np.int32)
+    replica_is_leader = np.array([1, 0, 1, 0, 1, 0, 1, 0, 1, 0], bool)
+    # Skew everything onto brokers 0/1.
+    replica_broker = np.array([0, 1, 0, 1, 0, 1, 0, 1, 1, 0], np.int32)
+    leader = np.array([
+        [10.0, 100.0, 130.0, 75.0],
+        [10.0, 100.0, 130.0, 75.0],
+        [10.0, 100.0, 130.0, 75.0],
+        [ 5.0,  50.0,  60.0, 40.0],
+        [ 5.0,  50.0,  60.0, 40.0],
+    ], np.float32)
+    follower = leader.copy()
+    follower[:, Resource.NW_OUT] = 0.0
+    follower[:, Resource.CPU] *= 0.4
+    broker_capacity = np.tile(np.array([100.0, 1000.0, 1000.0, 2000.0], np.float32), (3, 1))
+    broker_rack = np.array([0, 1, 2], np.int32)
+    return build_model(
+        replica_broker=replica_broker,
+        replica_partition=replica_partition,
+        replica_topic=replica_topic,
+        replica_is_leader=replica_is_leader,
+        replica_load_leader=leader[replica_partition],
+        replica_load_follower=follower[replica_partition],
+        broker_capacity=broker_capacity,
+        broker_rack=broker_rack,
+    )

@@ -1,0 +1,108 @@
+"""Aggregate cluster statistics.
+
+Parity with the reference's ``ClusterModelStats`` (model/ClusterModelStats.java:30):
+per-resource avg/max/min/std-dev of broker utilization, replica-count and
+leader-count statistics, topic-replica stats, and potential NW_OUT — the
+values goal comparators order candidate states by
+(Goal.ClusterModelStatsComparator, analyzer/goals/Goal.java).  Computed as a
+single jitted reduction over the tensor model.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import Array
+
+from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
+from cruise_control_tpu.model.tensor_model import TensorClusterModel
+
+
+@struct.dataclass
+class ClusterModelStats:
+    # per-resource broker utilization stats, f32[4]
+    resource_util_mean: Array
+    resource_util_max: Array
+    resource_util_min: Array
+    resource_util_std: Array
+    # replica / leader count stats over alive brokers
+    replica_count_mean: Array
+    replica_count_max: Array
+    replica_count_min: Array
+    replica_count_std: Array
+    leader_count_mean: Array
+    leader_count_max: Array
+    leader_count_min: Array
+    leader_count_std: Array
+    # potential NW_OUT stats
+    potential_nw_out_mean: Array
+    potential_nw_out_max: Array
+    num_alive_brokers: Array
+    num_replicas: Array
+
+    def to_dict(self) -> Dict[str, object]:
+        def ser(x):
+            import numpy as np
+            arr = np.asarray(x)
+            return arr.item() if arr.ndim == 0 else arr.tolist()
+
+        out = {}
+        for name in ("resource_util_mean", "resource_util_max", "resource_util_min",
+                     "resource_util_std"):
+            vals = ser(getattr(self, name))
+            out[name] = {r.resource_name: vals[r.value] for r in Resource}
+        for name in ("replica_count_mean", "replica_count_max", "replica_count_min",
+                     "replica_count_std", "leader_count_mean", "leader_count_max",
+                     "leader_count_min", "leader_count_std", "potential_nw_out_mean",
+                     "potential_nw_out_max", "num_alive_brokers", "num_replicas"):
+            out[name] = ser(getattr(self, name))
+        return out
+
+
+def _masked_stats(values: Array, mask: Array):
+    n = jnp.maximum(mask.sum(), 1)
+    mean = jnp.where(mask, values, 0.0).sum(axis=0) / n
+    vmax = jnp.where(mask, values, -jnp.inf).max(axis=0)
+    vmin = jnp.where(mask, values, jnp.inf).min(axis=0)
+    var = (jnp.where(mask, (values - mean) ** 2, 0.0)).sum(axis=0) / n
+    return mean, vmax, vmin, jnp.sqrt(var)
+
+
+def compute_stats(model: TensorClusterModel) -> ClusterModelStats:
+    """Populate stats over alive brokers (ClusterModelStats.populate,
+    model/ClusterModelStats.java:84)."""
+    alive = model.alive_broker_mask()
+    util = model.broker_load()
+    mean, vmax, vmin, std = _masked_stats(util, alive[:, None])
+
+    rc = model.broker_replica_counts().astype(jnp.float32)
+    rc_mean, rc_max, rc_min, rc_std = _masked_stats(rc, alive)
+    lc = model.broker_leader_counts().astype(jnp.float32)
+    lc_mean, lc_max, lc_min, lc_std = _masked_stats(lc, alive)
+
+    pnw = model.potential_leadership_load()
+    pnw_mean, pnw_max, _, _ = _masked_stats(pnw, alive)
+
+    return ClusterModelStats(
+        resource_util_mean=mean, resource_util_max=vmax, resource_util_min=vmin,
+        resource_util_std=std,
+        replica_count_mean=rc_mean, replica_count_max=rc_max, replica_count_min=rc_min,
+        replica_count_std=rc_std,
+        leader_count_mean=lc_mean, leader_count_max=lc_max, leader_count_min=lc_min,
+        leader_count_std=lc_std,
+        potential_nw_out_mean=pnw_mean, potential_nw_out_max=pnw_max,
+        num_alive_brokers=alive.sum(), num_replicas=model.replica_valid.sum(),
+    )
+
+
+compute_stats_jit = jax.jit(compute_stats)
+
+
+def utilization_variance(model: TensorClusterModel) -> Array:
+    """f32[4] variance of broker utilization per resource
+    (ClusterModel.variance, ClusterModel.java:1313)."""
+    stats = compute_stats(model)
+    return stats.resource_util_std ** 2

@@ -1,0 +1,415 @@
+"""The struct-of-arrays tensor cluster model.
+
+This is the TPU-native redesign of the reference's mutable object-graph
+``ClusterModel`` (cruise-control/src/main/java/.../model/ClusterModel.java:46,
+with Rack.java:30 / Host.java:26 / Broker.java:34 / Disk.java:29 /
+Replica.java:25 / Partition.java:20 as nested objects).  Where the reference
+cascades load bookkeeping through rack→host→broker object references on every
+replica move (ClusterModel.java:377-431), here the entire cluster state is a
+frozen pytree of flat arrays over three axes — replicas (R), brokers (B),
+partitions (P) — and every aggregate (broker/host/rack load, replica counts,
+potential leadership load, partition-rack occupancy) is a segment reduction
+recomputed in one fused XLA kernel.  Mutations are pure functions returning a
+new pytree, so candidate balancing actions can be *speculatively* evaluated
+in parallel (vmap over action batches) without copying any state.
+
+Load semantics: each replica carries two load rows — its utilization as a
+leader and as a follower (f32[R, 4] each, resource axis per
+``common.Resource``).  The actual load is selected by the leadership flag.
+This makes leadership movement a pure index flip with the same incremental
+load-delta semantics the reference implements imperatively in
+``Rack.makeFollower``/``makeLeader`` (ClusterModel.java:406-431): the
+follower rows keep only CPU+NW_IN+DISK components, matching how the
+reference strips leader-only load (NW_OUT, leadership CPU) when leadership
+transfers.
+
+Padding: R/B/P axes may be padded; ``*_valid`` masks mark live rows.  All
+shapes are static under ``jit``; broker/rack/host counts are static Python
+ints (pytree aux data).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+from jax import Array
+
+from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
+from cruise_control_tpu.ops.segment import masked_segment_count, masked_segment_sum
+
+
+class BrokerState:
+    """Broker liveness states (reference: model/Broker.java:37)."""
+
+    ALIVE = 0
+    DEAD = 1
+    NEW = 2
+    DEMOTED = 3
+    BAD_DISKS = 4
+
+
+@struct.dataclass
+class TensorClusterModel:
+    # --- replica axis (R) ---
+    replica_broker: Array  # i32[R] current broker id
+    replica_partition: Array  # i32[R] global partition id
+    replica_topic: Array  # i32[R] topic id
+    replica_is_leader: Array  # bool[R]
+    replica_load_leader: Array  # f32[R, 4] utilization if leader
+    replica_load_follower: Array  # f32[R, 4] utilization if follower
+    replica_valid: Array  # bool[R] padding mask
+    replica_original_broker: Array  # i32[R] broker at model build (immigrant tracking)
+    replica_offline: Array  # bool[R] replica on dead broker/disk
+    replica_disk: Array  # i32[R] global disk index (-1 when not JBOD)
+
+    # --- broker axis (B) ---
+    broker_capacity: Array  # f32[B, 4]
+    broker_rack: Array  # i32[B]
+    broker_host: Array  # i32[B]
+    broker_state: Array  # i8[B] BrokerState
+    broker_valid: Array  # bool[B]
+
+    # --- disk axis (D) --- (D == B when not JBOD; one implicit disk/broker)
+    disk_broker: Array  # i32[D]
+    disk_capacity: Array  # f32[D], < 0 means dead disk
+    disk_valid: Array  # bool[D]
+    broker_first_disk: Array  # i32[B] — default landing disk for inter-broker moves
+
+    # --- partition axis (P) ---
+    partition_topic: Array  # i32[P]
+    partition_valid: Array  # bool[P]
+    # i32[P, max_rf] replica ids of each partition (-1 pad).  Membership is
+    # static (moves change replica_broker, not partition membership), so this
+    # is built once and lets rack/legit-move checks gather a partition's
+    # sibling replicas in O(max_rf) instead of a P×B occupancy matrix.
+    partition_replicas: Array
+
+    # --- static metadata (aux data, not traced) ---
+    num_brokers: int = struct.field(pytree_node=False)
+    num_racks: int = struct.field(pytree_node=False)
+    num_hosts: int = struct.field(pytree_node=False)
+    num_topics: int = struct.field(pytree_node=False)
+    num_partitions: int = struct.field(pytree_node=False)
+    num_disks: int = struct.field(pytree_node=False)
+    max_rf: int = struct.field(pytree_node=False)
+
+    # ------------------------------------------------------------------
+    # Shape helpers
+    # ------------------------------------------------------------------
+    @property
+    def num_replicas_padded(self) -> int:
+        return self.replica_broker.shape[0]
+
+    # ------------------------------------------------------------------
+    # Load queries (reference: Load.java:29, ClusterModel.java:1299-1330)
+    # ------------------------------------------------------------------
+    def replica_load(self) -> Array:
+        """f32[R, 4] actual utilization given current leadership."""
+        return jnp.where(self.replica_is_leader[:, None], self.replica_load_leader,
+                         self.replica_load_follower)
+
+    def broker_load(self) -> Array:
+        """f32[B, 4] per-broker utilization — the generalization of
+        ``ClusterModel.utilizationMatrix()`` (ClusterModel.java:1330)."""
+        return masked_segment_sum(self.replica_load(), self.replica_broker,
+                                  self.num_brokers, self.replica_valid)
+
+    def host_load(self) -> Array:
+        """f32[H, 4] per-host utilization (host-level resources)."""
+        return masked_segment_sum(self.broker_load(), self.broker_host,
+                                  self.num_hosts, self.broker_valid)
+
+    def rack_load(self) -> Array:
+        return masked_segment_sum(self.broker_load(), self.broker_rack,
+                                  self.num_racks, self.broker_valid)
+
+    def potential_leadership_load(self) -> Array:
+        """f32[B] potential NW_OUT per broker if *all* its replicas led
+        (reference: ClusterModel.potentialLeadershipLoadFor, ClusterModel.java:219)."""
+        return masked_segment_sum(self.replica_load_leader[:, Resource.NW_OUT],
+                                  self.replica_broker, self.num_brokers, self.replica_valid)
+
+    def broker_replica_counts(self) -> Array:
+        """i32[B] replicas per broker."""
+        return masked_segment_count(self.replica_broker, self.num_brokers, self.replica_valid)
+
+    def broker_leader_counts(self) -> Array:
+        """i32[B] leader replicas per broker."""
+        return masked_segment_count(self.replica_broker, self.num_brokers,
+                                    self.replica_valid & self.replica_is_leader)
+
+    def broker_leader_bytes_in(self) -> Array:
+        """f32[B] leader NW_IN per broker (LeaderBytesInDistributionGoal input)."""
+        load = jnp.where(self.replica_is_leader, self.replica_load_leader[:, Resource.NW_IN], 0.0)
+        return masked_segment_sum(load, self.replica_broker, self.num_brokers, self.replica_valid)
+
+    def topic_broker_replica_counts(self) -> Array:
+        """i32[T, B] replicas of each topic on each broker (TopicReplicaDistributionGoal)."""
+        flat = self.replica_topic * self.num_brokers + self.replica_broker
+        counts = masked_segment_count(flat, self.num_topics * self.num_brokers, self.replica_valid)
+        return counts.reshape(self.num_topics, self.num_brokers)
+
+    def disk_load(self) -> Array:
+        """f32[D] disk utilization (DISK resource only)."""
+        disk_ids = jnp.where(self.replica_disk >= 0, self.replica_disk, 0)
+        mask = self.replica_valid & (self.replica_disk >= 0)
+        return masked_segment_sum(self.replica_load()[:, Resource.DISK], disk_ids,
+                                  self.num_disks, mask)
+
+    # ------------------------------------------------------------------
+    # Topology / placement queries
+    # ------------------------------------------------------------------
+    def partition_rack_counts(self) -> Array:
+        """i32[P, num_racks] — how many replicas of each partition sit in each
+        rack (the vectorized form of RackAwareGoal's per-partition scan,
+        goals/RackAwareGoal.java:33)."""
+        replica_rack = self.broker_rack[self.replica_broker]
+        flat = self.replica_partition * self.num_racks + replica_rack
+        counts = masked_segment_count(flat, self.num_partitions * self.num_racks,
+                                      self.replica_valid)
+        return counts.reshape(self.num_partitions, self.num_racks)
+
+    def partition_broker_counts(self) -> Array:
+        """i32[P, B] replica multiplicity per (partition, broker) — used to
+        forbid moving a replica onto a broker that already hosts the
+        partition (legitMove, goals/GoalUtils.java)."""
+        flat = self.replica_partition * self.num_brokers + self.replica_broker
+        counts = masked_segment_count(flat, self.num_partitions * self.num_brokers,
+                                      self.replica_valid)
+        return counts.reshape(self.num_partitions, self.num_brokers)
+
+    def partition_replication_factor(self) -> Array:
+        """i32[P] current replication factor per partition."""
+        return masked_segment_count(self.replica_partition, self.num_partitions,
+                                    self.replica_valid)
+
+    def partition_leader_replica(self) -> Array:
+        """i32[P] replica index of each partition's leader (-1 if none)."""
+        r_idx = jnp.arange(self.num_replicas_padded, dtype=jnp.int32)
+        mask = self.replica_valid & self.replica_is_leader
+        seg = jnp.where(mask, self.replica_partition, 0)
+        out = jnp.full((self.num_partitions,), -1, jnp.int32)
+        return out.at[seg].max(jnp.where(mask, r_idx, -1))
+
+    def alive_broker_mask(self) -> Array:
+        """bool[B] brokers that can receive replicas (reference:
+        ClusterModel.aliveBrokers — DEAD brokers excluded)."""
+        return self.broker_valid & (self.broker_state != BrokerState.DEAD)
+
+    def new_broker_mask(self) -> Array:
+        return self.broker_valid & (self.broker_state == BrokerState.NEW)
+
+    def demoted_broker_mask(self) -> Array:
+        return self.broker_valid & (self.broker_state == BrokerState.DEMOTED)
+
+    # ------------------------------------------------------------------
+    # Mutations (pure; return a new model)
+    # ------------------------------------------------------------------
+    def relocate_replicas(self, replica_ids: Array, dest_brokers: Array,
+                          apply_mask: Optional[Array] = None) -> "TensorClusterModel":
+        """Move replicas to destination brokers (vectorized
+        ``relocateReplica``, ClusterModel.java:377).  ``apply_mask`` lets a
+        fixed-size batch apply only its accepted prefix under jit."""
+        if apply_mask is None:
+            apply_mask = jnp.ones(replica_ids.shape, bool)
+        # Masked-out slots write their current value back (no-op).
+        current = self.replica_broker[replica_ids]
+        new_vals = jnp.where(apply_mask, dest_brokers.astype(jnp.int32), current)
+        new_broker = self.replica_broker.at[replica_ids].set(new_vals)
+        # An inter-broker move lands the replica on the destination broker's
+        # default disk (the reference picks a destination logdir in the
+        # proposal; intra-broker rebalancing then refines placement via
+        # relocate_replicas_to_disk).
+        cur_disk = self.replica_disk[replica_ids]
+        dest_disk = self.broker_first_disk[dest_brokers.astype(jnp.int32)]
+        new_disk_vals = jnp.where(apply_mask, dest_disk, cur_disk)
+        new_disk = self.replica_disk.at[replica_ids].set(new_disk_vals)
+        return self.replace(replica_broker=new_broker, replica_disk=new_disk)
+
+    def relocate_leadership(self, src_replica_ids: Array, dest_replica_ids: Array,
+                            apply_mask: Optional[Array] = None) -> "TensorClusterModel":
+        """Transfer leadership from leader replicas to follower replicas of
+        the same partitions (vectorized ``relocateLeadership``,
+        ClusterModel.java:406)."""
+        if apply_mask is None:
+            apply_mask = jnp.ones(src_replica_ids.shape, bool)
+        lead = self.replica_is_leader
+        src_cur = lead[src_replica_ids]
+        dst_cur = lead[dest_replica_ids]
+        lead = lead.at[src_replica_ids].set(jnp.where(apply_mask, False, src_cur))
+        lead = lead.at[dest_replica_ids].set(jnp.where(apply_mask, True, dst_cur))
+        return self.replace(replica_is_leader=lead)
+
+    def relocate_replicas_to_disk(self, replica_ids: Array, dest_disks: Array,
+                                  apply_mask: Optional[Array] = None) -> "TensorClusterModel":
+        """Intra-broker move: reassign replicas across a broker's disks."""
+        if apply_mask is None:
+            apply_mask = jnp.ones(replica_ids.shape, bool)
+        cur = self.replica_disk[replica_ids]
+        new_vals = jnp.where(apply_mask, dest_disks.astype(jnp.int32), cur)
+        return self.replace(replica_disk=self.replica_disk.at[replica_ids].set(new_vals))
+
+    def set_broker_state(self, broker_id: int, state: int) -> "TensorClusterModel":
+        """Set a broker's liveness state (ClusterModel.setBrokerState).
+        Marking DEAD also marks its replicas offline."""
+        new_state = self.broker_state.at[broker_id].set(state)
+        if state == BrokerState.DEAD:
+            on_broker = self.replica_broker == broker_id
+            new_offline = jnp.where(on_broker & self.replica_valid, True, self.replica_offline)
+        else:
+            new_offline = self.replica_offline
+        return self.replace(broker_state=new_state, replica_offline=new_offline)
+
+    # ------------------------------------------------------------------
+    # Sanity (reference: ClusterModel.sanityCheck, ClusterModel.java:1144)
+    # ------------------------------------------------------------------
+    def sanity_check(self) -> None:
+        """Host-side invariant checks; raises on violation."""
+        rb = np.asarray(self.replica_broker)
+        valid = np.asarray(self.replica_valid)
+        bvalid = np.asarray(self.broker_valid)
+        if not ((rb[valid] >= 0) & (rb[valid] < self.num_brokers)).all():
+            raise ValueError("replica assigned to out-of-range broker")
+        if not bvalid[rb[valid]].all():
+            raise ValueError("replica assigned to invalid broker slot")
+        # Exactly one leader per valid partition with >=1 replica.
+        leaders = np.asarray(masked_segment_count(
+            self.replica_partition, self.num_partitions,
+            self.replica_valid & self.replica_is_leader))
+        rf = np.asarray(self.partition_replication_factor())
+        bad = (rf > 0) & (leaders != 1)
+        if bad.any():
+            raise ValueError(f"partitions without exactly one leader: {np.nonzero(bad)[0][:10]}")
+        # No two replicas of one partition on the same broker.
+        pbc = np.asarray(self.partition_broker_counts())
+        if (pbc > 1).any():
+            raise ValueError("partition has multiple replicas on one broker")
+        # Replica's disk must belong to the broker hosting the replica.
+        rd = np.asarray(self.replica_disk)
+        disk_owner = np.asarray(self.disk_broker)
+        has_disk = valid & (rd >= 0)
+        if not (disk_owner[rd[has_disk]] == rb[has_disk]).all():
+            raise ValueError("replica assigned to a disk on a different broker")
+
+
+def build_model(
+    replica_broker: np.ndarray,
+    replica_partition: np.ndarray,
+    replica_topic: np.ndarray,
+    replica_is_leader: np.ndarray,
+    replica_load_leader: np.ndarray,
+    replica_load_follower: np.ndarray,
+    broker_capacity: np.ndarray,
+    broker_rack: np.ndarray,
+    broker_host: Optional[np.ndarray] = None,
+    broker_state: Optional[np.ndarray] = None,
+    partition_topic: Optional[np.ndarray] = None,
+    replica_disk: Optional[np.ndarray] = None,
+    disk_broker: Optional[np.ndarray] = None,
+    disk_capacity: Optional[np.ndarray] = None,
+    pad_replicas_to: Optional[int] = None,
+    pad_brokers_to: Optional[int] = None,
+) -> TensorClusterModel:
+    """Assemble a TensorClusterModel from host numpy arrays, with padding.
+
+    The edge-layer analogue of LoadMonitor's model generation
+    (monitor/LoadMonitor.java:455-520): callers produce flat arrays (from
+    aggregated samples + metadata) and this function performs padding,
+    validation, and device placement.
+    """
+    R = int(replica_broker.shape[0])
+    B = int(broker_capacity.shape[0])
+    Rp = int(pad_replicas_to or R)
+    Bp = int(pad_brokers_to or B)
+    if Rp < R or Bp < B:
+        raise ValueError("padding must not truncate")
+
+    if broker_host is None:
+        broker_host = np.arange(B, dtype=np.int32)  # one broker per host
+    if broker_state is None:
+        broker_state = np.zeros(B, np.int8)
+    num_topics = int(replica_topic.max()) + 1 if R else 1
+    num_partitions = int(replica_partition.max()) + 1 if R else 1
+    if partition_topic is None:
+        partition_topic = np.zeros(num_partitions, np.int32)
+        partition_topic[replica_partition] = replica_topic
+    P = int(partition_topic.shape[0])
+    num_racks = int(broker_rack.max()) + 1 if B else 1
+    num_hosts = int(broker_host.max()) + 1 if B else 1
+
+    if disk_broker is None:
+        # Non-JBOD: one implicit disk per broker, disk id == broker id.
+        disk_broker = np.arange(Bp, dtype=np.int32)
+        disk_capacity = np.zeros(Bp, np.float32)
+        disk_capacity[:B] = broker_capacity[:, Resource.DISK]
+        disk_valid = np.zeros(Bp, bool)
+        disk_valid[:B] = True
+        if replica_disk is None:
+            replica_disk = replica_broker.astype(np.int32)
+    else:
+        assert disk_capacity is not None and replica_disk is not None
+        disk_valid = np.ones(disk_broker.shape[0], bool)
+    D = int(disk_broker.shape[0])
+    # Default landing disk per broker: lowest disk index owned by the broker.
+    broker_first_disk = np.zeros(Bp, np.int32)
+    for d in range(D - 1, -1, -1):
+        b = int(disk_broker[d])
+        if 0 <= b < Bp:
+            broker_first_disk[b] = d
+
+    def pad(arr, n, fill=0):
+        out = np.full((n,) + arr.shape[1:], fill, arr.dtype)
+        out[: arr.shape[0]] = arr
+        return out
+
+    replica_valid = np.zeros(Rp, bool)
+    replica_valid[:R] = True
+    broker_valid = np.zeros(Bp, bool)
+    broker_valid[:B] = True
+
+    # Build the partition→replica-ids table (static membership).
+    rf_counts = np.bincount(replica_partition, minlength=P)
+    max_rf = int(rf_counts.max()) if R else 1
+    partition_replicas = np.full((P, max_rf), -1, np.int32)
+    slot = np.zeros(P, np.int64)
+    for i in range(R):
+        p = replica_partition[i]
+        partition_replicas[p, slot[p]] = i
+        slot[p] += 1
+
+    model = TensorClusterModel(
+        replica_broker=jnp.asarray(pad(replica_broker.astype(np.int32), Rp)),
+        replica_partition=jnp.asarray(pad(replica_partition.astype(np.int32), Rp)),
+        replica_topic=jnp.asarray(pad(replica_topic.astype(np.int32), Rp)),
+        replica_is_leader=jnp.asarray(pad(replica_is_leader.astype(bool), Rp)),
+        replica_load_leader=jnp.asarray(pad(replica_load_leader.astype(np.float32), Rp)),
+        replica_load_follower=jnp.asarray(pad(replica_load_follower.astype(np.float32), Rp)),
+        replica_valid=jnp.asarray(replica_valid),
+        replica_original_broker=jnp.asarray(pad(replica_broker.astype(np.int32), Rp)),
+        replica_offline=jnp.asarray(np.zeros(Rp, bool)),
+        replica_disk=jnp.asarray(pad(replica_disk.astype(np.int32), Rp)),
+        broker_capacity=jnp.asarray(pad(broker_capacity.astype(np.float32), Bp)),
+        broker_rack=jnp.asarray(pad(broker_rack.astype(np.int32), Bp)),
+        broker_host=jnp.asarray(pad(broker_host.astype(np.int32), Bp)),
+        broker_state=jnp.asarray(pad(broker_state.astype(np.int8), Bp)),
+        broker_valid=jnp.asarray(broker_valid),
+        disk_broker=jnp.asarray(disk_broker.astype(np.int32)),
+        disk_capacity=jnp.asarray(disk_capacity.astype(np.float32)),
+        disk_valid=jnp.asarray(disk_valid),
+        broker_first_disk=jnp.asarray(broker_first_disk),
+        partition_topic=jnp.asarray(partition_topic.astype(np.int32)),
+        partition_valid=jnp.asarray(np.ones(P, bool)),
+        partition_replicas=jnp.asarray(partition_replicas),
+        num_brokers=Bp,
+        num_racks=num_racks,
+        num_hosts=num_hosts,
+        num_topics=num_topics,
+        num_partitions=P,
+        num_disks=D,
+        max_rf=max_rf,
+    )
+    return model

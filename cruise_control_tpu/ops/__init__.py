@@ -1,0 +1,13 @@
+from cruise_control_tpu.ops.segment import (
+    masked_segment_sum,
+    masked_segment_count,
+    segment_max,
+    segment_min,
+)
+
+__all__ = [
+    "masked_segment_sum",
+    "masked_segment_count",
+    "segment_max",
+    "segment_min",
+]

@@ -1,0 +1,60 @@
+"""Segment reductions used by the tensor cluster model.
+
+These are the TPU-native replacement for the reference's per-broker
+bookkeeping (Rack/Host/Broker cascading load updates —
+model/ClusterModel.java:428-431): instead of mutating per-object
+accumulators on every replica move, broker/host/rack aggregates are
+*recomputed* as one XLA scatter-add over the replica axis, which lowers to a
+single fused kernel and vectorizes over the resource axis for free.
+
+All functions take a static ``num_segments`` so shapes stay static under
+``jit``.  Invalid rows are handled with a mask (padding rows carry segment id
+pointing anywhere; the mask zeroes their contribution) — the standard
+padding+mask idiom for dynamic-size data on TPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def masked_segment_sum(values: Array, segment_ids: Array, num_segments: int, mask: Array | None = None) -> Array:
+    """Sum ``values`` rows into ``num_segments`` buckets, zeroing masked rows.
+
+    values: f32[N, ...]; segment_ids: i32[N]; mask: bool[N] or None.
+    Returns f32[num_segments, ...].
+    """
+    if mask is not None:
+        expand = (slice(None),) + (None,) * (values.ndim - 1)
+        values = jnp.where(mask[expand], values, 0)
+        segment_ids = jnp.where(mask, segment_ids, 0)
+    out_shape = (num_segments,) + values.shape[1:]
+    return jnp.zeros(out_shape, values.dtype).at[segment_ids].add(values)
+
+
+def masked_segment_count(segment_ids: Array, num_segments: int, mask: Array | None = None) -> Array:
+    """Count rows per segment. Returns i32[num_segments]."""
+    ones = jnp.ones(segment_ids.shape[0], jnp.int32)
+    if mask is not None:
+        ones = jnp.where(mask, ones, 0)
+        segment_ids = jnp.where(mask, segment_ids, 0)
+    return jnp.zeros((num_segments,), jnp.int32).at[segment_ids].add(ones)
+
+
+def segment_max(values: Array, segment_ids: Array, num_segments: int, mask: Array | None = None,
+                initial: float = 0.0) -> Array:
+    """Per-segment max with masked rows contributing ``initial``."""
+    if mask is not None:
+        values = jnp.where(mask, values, initial)
+        segment_ids = jnp.where(mask, segment_ids, 0)
+    return jnp.full((num_segments,), initial, values.dtype).at[segment_ids].max(values)
+
+
+def segment_min(values: Array, segment_ids: Array, num_segments: int, mask: Array | None = None,
+                initial: float = jnp.inf) -> Array:
+    """Per-segment min with masked rows contributing ``initial``."""
+    if mask is not None:
+        values = jnp.where(mask, values, initial)
+        segment_ids = jnp.where(mask, segment_ids, 0)
+    return jnp.full((num_segments,), initial, values.dtype).at[segment_ids].min(values)

@@ -1,0 +1,24 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; sharding behavior is
+validated on a virtual 8-device CPU platform, matching how the driver's
+dryrun_multichip exercises the multi-chip path.
+
+Note: the environment may auto-register a remote TPU PJRT plugin at
+interpreter startup and force ``jax_platforms`` to include it; its backend
+init goes over a network tunnel and takes minutes.  Resetting the
+``jax_platforms`` config (not just the env var) BEFORE any backend
+initialization keeps the whole suite on the fast local CPU path.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

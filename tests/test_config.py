@@ -1,0 +1,68 @@
+import pytest
+
+from cruise_control_tpu.config import (
+    Config,
+    ConfigDef,
+    ConfigException,
+    Importance,
+    Range,
+    Type,
+    cruise_control_config,
+)
+from cruise_control_tpu.config import constants as C
+
+
+def test_defaults_parse():
+    cfg = cruise_control_config()
+    assert cfg.get_double(C.CPU_BALANCE_THRESHOLD_CONFIG) == 1.1
+    assert cfg.get_double(C.CPU_CAPACITY_THRESHOLD_CONFIG) == 0.7
+    assert cfg.get_double(C.DISK_CAPACITY_THRESHOLD_CONFIG) == 0.8
+    assert cfg.get_int(C.NUM_PARTITION_METRICS_WINDOWS_CONFIG) == 5
+    assert cfg.get(C.PARTITION_METRICS_WINDOW_MS_CONFIG) == 300000
+    assert cfg.get_int(C.NUM_CONCURRENT_PARTITION_MOVEMENTS_PER_BROKER_CONFIG) == 10
+    assert "RackAwareGoal" in cfg.get_list(C.DEFAULT_GOALS_CONFIG)
+    assert cfg.get(C.PROPOSAL_EXPIRATION_MS_CONFIG) == 60000
+
+
+def test_override_and_coercion():
+    cfg = cruise_control_config({
+        C.CPU_BALANCE_THRESHOLD_CONFIG: "1.5",
+        C.MAX_REPLICAS_PER_BROKER_CONFIG: "5000",
+        C.SELF_HEALING_ENABLED_CONFIG: "true",
+        C.DEFAULT_GOALS_CONFIG: "RackAwareGoal, ReplicaCapacityGoal",
+    })
+    assert cfg.get_double(C.CPU_BALANCE_THRESHOLD_CONFIG) == 1.5
+    assert cfg.get(C.MAX_REPLICAS_PER_BROKER_CONFIG) == 5000
+    assert cfg.get_boolean(C.SELF_HEALING_ENABLED_CONFIG) is True
+    assert cfg.get_list(C.DEFAULT_GOALS_CONFIG) == ["RackAwareGoal", "ReplicaCapacityGoal"]
+
+
+def test_validator_rejects_out_of_range():
+    with pytest.raises(ConfigException):
+        cruise_control_config({C.CPU_CAPACITY_THRESHOLD_CONFIG: 1.5})
+    with pytest.raises(ConfigException):
+        cruise_control_config({C.CPU_BALANCE_THRESHOLD_CONFIG: 0.5})
+
+
+def test_required_key_missing():
+    d = ConfigDef().define("required.key", Type.STRING)
+    with pytest.raises(ConfigException):
+        Config(d, {})
+    assert Config(d, {"required.key": "x"}).get("required.key") == "x"
+
+
+def test_unknown_type_mismatch():
+    d = ConfigDef().define("an.int", Type.INT, 1)
+    with pytest.raises(ConfigException):
+        Config(d, {"an.int": "not-a-number"})
+
+
+def test_duplicate_definition_rejected():
+    d = ConfigDef().define("k", Type.INT, 1)
+    with pytest.raises(ConfigException):
+        d.define("k", Type.INT, 2)
+
+
+def test_doc_table_renders():
+    table = ConfigDef().define("k", Type.INT, 1, doc="a knob").doc_table()
+    assert "a knob" in table

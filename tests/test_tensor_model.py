@@ -1,0 +1,160 @@
+"""Tensor cluster model tests with a NumPy oracle.
+
+Mirrors the reference's model-layer unit tests (ClusterModelTest and the
+DeterministicCluster fixtures): broker/host load accounting, leadership
+transfer deltas, replica relocation, partition-rack occupancy, sanity checks.
+"""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
+from cruise_control_tpu.model import (
+    BrokerState,
+    ClusterSpec,
+    compute_stats,
+    generate_cluster,
+    small_deterministic_cluster,
+)
+
+
+def oracle_broker_load(model):
+    """NumPy reference implementation of broker_load()."""
+    rb = np.asarray(model.replica_broker)
+    valid = np.asarray(model.replica_valid)
+    lead = np.asarray(model.replica_is_leader)
+    ll = np.asarray(model.replica_load_leader)
+    lf = np.asarray(model.replica_load_follower)
+    load = np.where(lead[:, None], ll, lf)
+    out = np.zeros((model.num_brokers, NUM_RESOURCES), np.float64)
+    for i in range(rb.shape[0]):
+        if valid[i]:
+            out[rb[i]] += load[i]
+    return out
+
+
+@pytest.fixture(scope="module")
+def random_model():
+    return generate_cluster(ClusterSpec(num_brokers=6, num_racks=3, num_topics=4,
+                                        mean_partitions_per_topic=15, replication_factor=3,
+                                        distribution="linear", seed=7))
+
+
+def test_broker_load_matches_oracle(random_model):
+    got = np.asarray(random_model.broker_load())
+    want = oracle_broker_load(random_model)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_host_load_sums_brokers(random_model):
+    bl = np.asarray(random_model.broker_load())
+    hosts = np.asarray(random_model.broker_host)
+    want = np.zeros((random_model.num_hosts, NUM_RESOURCES))
+    for b in range(random_model.num_brokers):
+        want[hosts[b]] += bl[b]
+    np.testing.assert_allclose(np.asarray(random_model.host_load()), want, rtol=1e-5)
+
+
+def test_replica_counts(random_model):
+    rb = np.asarray(random_model.replica_broker)
+    valid = np.asarray(random_model.replica_valid)
+    want = np.bincount(rb[valid], minlength=random_model.num_brokers)
+    np.testing.assert_array_equal(np.asarray(random_model.broker_replica_counts()), want)
+
+
+def test_sanity_check_passes(random_model):
+    random_model.sanity_check()
+
+
+def test_partition_rack_counts_and_rf(random_model):
+    prc = np.asarray(random_model.partition_rack_counts())
+    rf = np.asarray(random_model.partition_replication_factor())
+    assert (prc.sum(axis=1) == rf).all()
+    assert (rf == 3).all()
+
+
+def test_relocate_replica_moves_load():
+    model = small_deterministic_cluster()
+    before = np.asarray(model.broker_load())
+    # replica 0 (leader of partition 0) lives on broker 0; move it to broker 2.
+    moved = model.relocate_replicas(np.array([0]), np.array([2]))
+    after = np.asarray(moved.broker_load())
+    load0 = np.asarray(model.replica_load())[0]
+    np.testing.assert_allclose(after[0], before[0] - load0, rtol=1e-5)
+    np.testing.assert_allclose(after[2], before[2] + load0, rtol=1e-5)
+    moved.sanity_check()
+
+
+def test_relocate_leadership_flips_loads():
+    model = small_deterministic_cluster()
+    # partition 0: leader replica 0 (broker 0), follower replica 1 (broker 1).
+    moved = model.relocate_leadership(np.array([0]), np.array([1]))
+    assert not bool(moved.replica_is_leader[0])
+    assert bool(moved.replica_is_leader[1])
+    before = np.asarray(model.broker_load())
+    after = np.asarray(moved.broker_load())
+    # NW_OUT of partition 0 leaves broker 0 and lands on broker 1.
+    nw_out = float(model.replica_load_leader[0, Resource.NW_OUT])
+    assert after[0, Resource.NW_OUT] == pytest.approx(before[0, Resource.NW_OUT] - nw_out, rel=1e-5)
+    assert after[1, Resource.NW_OUT] == pytest.approx(before[1, Resource.NW_OUT] + nw_out, rel=1e-5)
+    # DISK unchanged by leadership moves.
+    np.testing.assert_allclose(after[:, Resource.DISK], before[:, Resource.DISK], rtol=1e-6)
+    moved.sanity_check()
+
+
+def test_apply_mask_suppresses_moves():
+    model = small_deterministic_cluster()
+    moved = model.relocate_replicas(np.array([0, 2]), np.array([2, 2]),
+                                    apply_mask=np.array([False, True]))
+    assert int(moved.replica_broker[0]) == 0  # masked out — unchanged
+    assert int(moved.replica_broker[2]) == 2
+
+
+def test_dead_broker_marks_replicas_offline():
+    model = small_deterministic_cluster()
+    dead = model.set_broker_state(1, BrokerState.DEAD)
+    offline = np.asarray(dead.replica_offline)
+    rb = np.asarray(dead.replica_broker)
+    assert (offline == (rb == 1)).all()
+    assert not np.asarray(dead.alive_broker_mask())[1]
+
+
+def test_potential_leadership_load(random_model):
+    want = np.zeros(random_model.num_brokers)
+    rb = np.asarray(random_model.replica_broker)
+    valid = np.asarray(random_model.replica_valid)
+    ll = np.asarray(random_model.replica_load_leader)[:, Resource.NW_OUT]
+    for i in range(rb.shape[0]):
+        if valid[i]:
+            want[rb[i]] += ll[i]
+    np.testing.assert_allclose(np.asarray(random_model.potential_leadership_load()), want, rtol=1e-5)
+
+
+def test_stats_sane(random_model):
+    stats = compute_stats(random_model)
+    d = stats.to_dict()
+    assert d["num_alive_brokers"] == 6
+    assert d["num_replicas"] == int(np.asarray(random_model.replica_valid).sum())
+    bl = oracle_broker_load(random_model)
+    assert d["resource_util_mean"]["cpu"] == pytest.approx(bl[:, 0].mean(), rel=1e-4)
+    assert d["resource_util_max"]["disk"] == pytest.approx(bl[:, 3].max(), rel=1e-4)
+
+
+def test_leader_uniqueness_enforced():
+    model = small_deterministic_cluster()
+    # Illegally promote a second replica of partition 0 to leader.
+    bad = model.replace(replica_is_leader=model.replica_is_leader.at[1].set(True))
+    with pytest.raises(ValueError):
+        bad.sanity_check()
+
+
+def test_topic_broker_replica_counts(random_model):
+    tbc = np.asarray(random_model.topic_broker_replica_counts())
+    rt = np.asarray(random_model.replica_topic)
+    rb = np.asarray(random_model.replica_broker)
+    valid = np.asarray(random_model.replica_valid)
+    want = np.zeros((random_model.num_topics, random_model.num_brokers), int)
+    for i in range(rt.shape[0]):
+        if valid[i]:
+            want[rt[i], rb[i]] += 1
+    np.testing.assert_array_equal(tbc, want)
