@@ -1,0 +1,146 @@
+"""Candidate action generation.
+
+The reference generates candidate actions by iterating sorted replica views
+per broker and probing candidate destination brokers through a PriorityQueue
+(ResourceDistributionGoal.rebalanceForBroker, goals/ResourceDistributionGoal.java:383-535;
+SortedReplicas, model/SortedReplicas.java:47).  Here generation is a pure
+tensor program: a goal ranks every replica (``source_replica_relevance``) and
+every broker (``dest_room``) in one pass, the top-S replicas are crossed
+with the top-D destination brokers, and legitimacy (GoalUtils.legitMove
+semantics plus ``OptimizationOptions`` exclusions) becomes a boolean mask
+over the K = S·D candidate batch.  Leadership candidates pair the top
+leader replicas with their partitions' follower siblings (max_rf wide).
+
+Everything is shape-static: S, D are Python ints chosen from the padded
+model shapes, so one compiled graph serves every step of a goal.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from cruise_control_tpu.analyzer.actions import ActionType, Candidates, make_candidates
+from cruise_control_tpu.analyzer.balancing_constraint import BalancingConstraint
+from cruise_control_tpu.analyzer.goals import kernels
+from cruise_control_tpu.analyzer.goals.specs import GoalSpec
+from cruise_control_tpu.analyzer.state import BrokerArrays, OptimizationOptions
+from cruise_control_tpu.model.tensor_model import BrokerState, TensorClusterModel
+
+_NEG = -1e29  # "irrelevant" sentinel threshold (relevance uses -1e30)
+
+
+def default_num_sources(model: TensorClusterModel) -> int:
+    """Top-S source replicas per step: wide enough to feed one action per
+    broker pair, capped so the candidate batch stays MXU-friendly, and never
+    wider than the replica axis (top_k requires k <= length)."""
+    return max(1, min(model.num_replicas_padded, max(8, min(4 * model.num_brokers, 512))))
+
+
+def default_num_dests(model: TensorClusterModel) -> int:
+    return max(1, min(model.num_brokers, 32))
+
+
+def move_candidates(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
+                    constraint: BalancingConstraint, options: OptimizationOptions,
+                    num_sources: int, num_dests: int) -> Candidates:
+    """K = S·D inter-broker replica-move candidates for this goal."""
+    relevance = kernels.source_replica_relevance(spec, model, arrays, constraint)
+    rel_vals, src_replicas = jax.lax.top_k(relevance, num_sources)  # [S]
+    room = kernels.dest_room(spec, model, arrays, constraint)
+    # Destinations must be able to receive replicas at all.
+    recv_ok = arrays.alive & ~options.broker_excluded_replica_move
+    any_requested = options.requested_dest_only.any()
+    recv_ok = recv_ok & (~any_requested | options.requested_dest_only)
+    room = jnp.where(recv_ok, room, -jnp.inf)
+    _, dest_brokers = jax.lax.top_k(room, num_dests)  # [D]
+
+    replica = jnp.repeat(src_replicas, num_dests)          # [K]
+    dest = jnp.tile(dest_brokers, num_sources)             # [K]
+    src_ok = jnp.repeat(rel_vals > _NEG, num_dests)
+
+    k = replica.shape[0]
+    action_type = jnp.full((k,), ActionType.INTER_BROKER_REPLICA_MOVEMENT, jnp.int32)
+    dest_replica = jnp.full((k,), -1, jnp.int32)
+
+    valid = src_ok & _legit_move_mask(model, arrays, options, replica, dest)
+    return make_candidates(model, replica, dest, action_type, dest_replica, valid)
+
+
+def _legit_move_mask(model: TensorClusterModel, arrays: BrokerArrays,
+                     options: OptimizationOptions, replica: Array, dest: Array) -> Array:
+    """bool[K] — GoalUtils.legitMove semantics for inter-broker moves:
+    destination alive and eligible, not already hosting the partition, and
+    the replica is movable under the request's exclusions."""
+    src = model.replica_broker[replica]
+    part = model.replica_partition[replica]
+    topic = model.replica_topic[replica]
+
+    dest_alive = arrays.alive[dest]
+    not_self = dest != src
+    # Destination must not already host a replica of the partition
+    # (checked via the partition's static sibling table, O(max_rf)).
+    sib = model.partition_replicas[part]                       # [K, max_rf]
+    sib_valid = (sib >= 0) & (sib != replica[:, None])
+    sib_broker = model.replica_broker[jnp.where(sib >= 0, sib, 0)]
+    already_there = (sib_valid & (sib_broker == dest[:, None])).any(axis=1)
+
+    offline = model.replica_offline_now()[replica] | (~arrays.alive[src])
+    topic_ok = ~options.topic_excluded[topic] | offline
+    immigrant = model.replica_broker[replica] != model.replica_original_broker[replica]
+    immigrant_ok = ~options.only_move_immigrants | immigrant | offline
+    dest_ok = ~options.broker_excluded_replica_move[dest]
+    any_requested = options.requested_dest_only.any()
+    requested_ok = ~any_requested | options.requested_dest_only[dest]
+
+    return (model.replica_valid[replica] & dest_alive & not_self & ~already_there
+            & topic_ok & immigrant_ok & dest_ok & requested_ok)
+
+
+def leadership_candidates(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
+                          constraint: BalancingConstraint, options: OptimizationOptions,
+                          num_sources: int) -> Candidates:
+    """K = S·max_rf leadership-transfer candidates: each top-ranked leader
+    replica paired with each follower sibling of its partition
+    (relocateLeadership semantics, ClusterModel.java:406)."""
+    relevance = kernels.source_replica_relevance(spec, model, arrays, constraint)
+    relevance = jnp.where(model.replica_is_leader, relevance, -jnp.inf)
+    rel_vals, src_replicas = jax.lax.top_k(relevance, num_sources)  # [S]
+
+    part = model.replica_partition[src_replicas]
+    sib = model.partition_replicas[part]                       # [S, max_rf]
+    max_rf = sib.shape[1]
+
+    replica = jnp.repeat(src_replicas, max_rf)                 # [K]
+    dest_replica = sib.reshape(-1)                             # [K]
+    src_ok = jnp.repeat(rel_vals > _NEG, max_rf)
+
+    safe_dest = jnp.where(dest_replica >= 0, dest_replica, 0)
+    dest_broker = model.replica_broker[safe_dest]
+    # Leadership may only land on an alive, non-demoted, non-excluded broker
+    # hosting a valid online follower (PreferredLeaderElectionGoal /
+    # GoalUtils eligibility).
+    dest_state = model.broker_state[dest_broker]
+    dest_ok = (
+        (dest_replica >= 0)
+        & (dest_replica != replica)
+        & model.replica_valid[safe_dest]
+        & ~model.replica_offline_now()[safe_dest]
+        & arrays.alive[dest_broker]
+        & (dest_state != BrokerState.DEMOTED)
+        & ~options.broker_excluded_leadership[dest_broker]
+    )
+    is_leader = model.replica_is_leader[replica]
+
+    k = replica.shape[0]
+    action_type = jnp.full((k,), ActionType.LEADERSHIP_MOVEMENT, jnp.int32)
+    valid = src_ok & is_leader & dest_ok & model.replica_valid[replica]
+    # dest_brokers arg is unused for leadership (dest derives from
+    # dest_replica inside make_candidates).
+    return make_candidates(model, replica, jnp.zeros((k,), jnp.int32), action_type,
+                           dest_replica, valid)
+
+
+def concat_candidates(a: Candidates, b: Candidates) -> Candidates:
+    return jax.tree.map(lambda x, y: jnp.concatenate([x, y], axis=0), a, b)

@@ -236,7 +236,7 @@ def _move_rack_ok(spec: GoalSpec, model: TensorClusterModel, cand: Candidates) -
 
 def _src_unhealthy(model: TensorClusterModel, cand: Candidates, arrays: BrokerArrays) -> Array:
     """Source broker dead or the replica itself offline — healing moves."""
-    return (~arrays.alive[cand.src]) | model.replica_offline[cand.replica]
+    return (~arrays.alive[cand.src]) | model.replica_offline_now()[cand.replica]
 
 
 def self_feasible(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
@@ -360,6 +360,17 @@ def source_pressure(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArr
     over = jnp.maximum(metric - upper, 0.0)
     scale = jnp.maximum(jnp.abs(upper), 1.0)
     pressure = over / scale
+    # Pull mechanism (rebalanceByMovingLoadIn,
+    # ResourceDistributionGoal.java:446-535): when some broker sits below the
+    # lower limit, in-band brokers above the band midpoint become donors too
+    # (weakly, so genuinely overloaded brokers still rank first).
+    eps = _metric_epsilon(spec)
+    under_exists = (arrays.alive & (metric < lower - eps)).any()
+    # Low-utilization-gated goals (upper == _BIG) have no meaningful band
+    # midpoint: neutralize the donor term there (same pattern as score()).
+    target = jnp.where(upper >= _BIG, metric, (lower + upper) * 0.5)
+    donor = jnp.maximum(metric - target, 0.0) / scale * 0.01
+    pressure = pressure + jnp.where(under_exists, donor, 0.0)
     dead = (~arrays.alive) & arrays.valid & (arrays.replica_count > 0)
     return jnp.where(dead, _BIG, jnp.where(arrays.valid, pressure, -_BIG))
 
@@ -398,7 +409,7 @@ def source_replica_relevance(spec: GoalSpec, model: TensorClusterModel, arrays: 
         tiebreak = _replica_metric_contribution(spec, model)
         scale = jnp.maximum(jnp.abs(tiebreak).max(), 1e-9)
         base = jnp.where(relevant, pressure + 1e-3 * tiebreak / scale, -_BIG)
-    offline = model.replica_offline | (~arrays.alive[model.replica_broker])
+    offline = model.replica_offline_now() | (~arrays.alive[model.replica_broker])
     base = jnp.where(offline, _BIG, base)
     return jnp.where(model.replica_valid, base, -_BIG)
 

@@ -1,0 +1,147 @@
+"""Proposal diff: initial vs optimized replica distributions.
+
+Parity with ``AnalyzerUtils.getDiff`` (analyzer/AnalyzerUtils.java:64-112)
+and ``ExecutionProposal`` (executor/ExecutionProposal.java:26): compare the
+pre-optimization and post-optimization placements partition by partition and
+emit one proposal per changed partition carrying the old leader, old replica
+list, and new replica list (leader first).  The executor consumes these.
+
+The diff itself is a host-side numpy pass over the partition→replica table —
+it runs once per optimization (not in the hot loop) and produces Python
+objects for the control plane, so it deliberately lives off-device.  A C++
+fast path takes over at the 1M-replica scale (see native/).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from cruise_control_tpu.model.tensor_model import TensorClusterModel
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaPlacement:
+    """(broker, disk) placement (model/ReplicaPlacementInfo.java)."""
+
+    broker: int
+    disk: int = -1
+
+
+@dataclasses.dataclass(frozen=True)
+class ExecutionProposal:
+    """One partition's reassignment (executor/ExecutionProposal.java:26)."""
+
+    partition: int
+    topic: int
+    partition_size: float  # DISK footprint of the leader replica (MB)
+    old_leader: ReplicaPlacement
+    old_replicas: Tuple[ReplicaPlacement, ...]
+    new_replicas: Tuple[ReplicaPlacement, ...]
+
+    @property
+    def new_leader(self) -> ReplicaPlacement:
+        return self.new_replicas[0]
+
+    @property
+    def replicas_to_add(self) -> Tuple[int, ...]:
+        old = {p.broker for p in self.old_replicas}
+        return tuple(p.broker for p in self.new_replicas if p.broker not in old)
+
+    @property
+    def replicas_to_remove(self) -> Tuple[int, ...]:
+        new = {p.broker for p in self.new_replicas}
+        return tuple(p.broker for p in self.old_replicas if p.broker not in new)
+
+    @property
+    def has_replica_action(self) -> bool:
+        return bool(self.replicas_to_add or self.replicas_to_remove
+                    or self._intra_broker_moves())
+
+    @property
+    def has_leader_action(self) -> bool:
+        return self.old_leader.broker != self.new_leader.broker or \
+            self.old_replicas[0].broker != self.new_replicas[0].broker
+
+    def _intra_broker_moves(self) -> List[Tuple[int, int, int]]:
+        """(broker, old_disk, new_disk) for replicas that changed disk only."""
+        old_by_broker = {p.broker: p.disk for p in self.old_replicas}
+        out = []
+        for p in self.new_replicas:
+            if p.broker in old_by_broker and old_by_broker[p.broker] != p.disk:
+                out.append((p.broker, old_by_broker[p.broker], p.disk))
+        return out
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "partition": self.partition,
+            "topic": self.topic,
+            "partitionSize": self.partition_size,
+            "oldLeader": self.old_leader.broker,
+            "oldReplicas": [p.broker for p in self.old_replicas],
+            "newReplicas": [p.broker for p in self.new_replicas],
+        }
+
+
+def _partition_placements(model: TensorClusterModel):
+    """Host arrays: per partition, ordered (leader first) replica placements."""
+    pr = np.asarray(model.partition_replicas)          # [P, max_rf]
+    rb = np.asarray(model.replica_broker)
+    rd = np.asarray(model.replica_disk)
+    lead = np.asarray(model.replica_is_leader)
+    valid = np.asarray(model.replica_valid)
+    return pr, rb, rd, lead, valid
+
+
+def diff(initial: TensorClusterModel, final: TensorClusterModel) -> List[ExecutionProposal]:
+    """Emit proposals for partitions whose placement or leadership changed.
+
+    Replica-list order follows the reference's convention: the (new) leader
+    first, then the remaining replicas in partition-table order — the order
+    Kafka receives in the reassignment request.
+    """
+    pr0, rb0, rd0, lead0, valid0 = _partition_placements(initial)
+    pr1, rb1, rd1, lead1, valid1 = _partition_placements(final)
+    if pr0.shape != pr1.shape:
+        raise ValueError("initial/final models have different partition tables")
+
+    load = np.asarray(initial.replica_load())
+    ptopic = np.asarray(initial.partition_topic)
+    from cruise_control_tpu.common.resources import Resource
+
+    # Vectorized prefilter: only partitions with any change produce objects.
+    sl = pr0 >= 0
+    b0 = np.where(sl, rb0[np.where(sl, pr0, 0)], -1)
+    b1 = np.where(sl, rb1[np.where(sl, pr1, 0)], -1)
+    d0 = np.where(sl, rd0[np.where(sl, pr0, 0)], -1)
+    d1 = np.where(sl, rd1[np.where(sl, pr1, 0)], -1)
+    l0 = np.where(sl, lead0[np.where(sl, pr0, 0)], False)
+    l1 = np.where(sl, lead1[np.where(sl, pr1, 0)], False)
+    changed = ((b0 != b1) | (l0 != l1) | (d0 != d1)).any(axis=1)
+    changed &= np.asarray(initial.partition_valid)
+
+    proposals: List[ExecutionProposal] = []
+    for p in np.nonzero(changed)[0]:
+        slots = pr0[p][pr0[p] >= 0]
+        if slots.size == 0:
+            continue
+
+        def ordered(rb, rd, lead):
+            placements = [ReplicaPlacement(int(rb[r]), int(rd[r])) for r in slots]
+            leader_pos = next((i for i, r in enumerate(slots) if lead[r]), 0)
+            if leader_pos:
+                placements = [placements[leader_pos]] + placements[:leader_pos] + \
+                    placements[leader_pos + 1:]
+            return tuple(placements)
+
+        old = ordered(rb0, rd0, lead0)
+        new = ordered(rb1, rd1, lead1)
+        if old == new:
+            continue
+        size = float(load[slots, Resource.DISK].max())
+        proposals.append(ExecutionProposal(
+            partition=int(p), topic=int(ptopic[p]), partition_size=size,
+            old_leader=old[0], old_replicas=old, new_replicas=new))
+    return proposals

@@ -1,0 +1,103 @@
+"""Post-hoc optimization invariant verification.
+
+Parity with the reference's ``OptimizationVerifier``
+(cruise-control/src/test/java/.../analyzer/OptimizationVerifier.java:53),
+which validates optimizer output on randomized inputs by *invariant
+checking* rather than golden outputs: proposals reachable, no
+replication-factor change, goal satisfaction, stats not regressed.  Used by
+the property tests and exposed to the API layer for dry-run validation.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from cruise_control_tpu.analyzer.balancing_constraint import BalancingConstraint
+from cruise_control_tpu.analyzer.goals import kernels
+from cruise_control_tpu.analyzer.goals.specs import goals_by_priority
+from cruise_control_tpu.analyzer.optimizer import OptimizerRun
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.analyzer.state import BrokerArrays
+from cruise_control_tpu.model.tensor_model import TensorClusterModel
+
+
+class VerificationError(AssertionError):
+    pass
+
+
+def verify_run(initial: TensorClusterModel, run: OptimizerRun,
+               goal_names: Sequence[str],
+               constraint: Optional[BalancingConstraint] = None,
+               proposals: Optional[List[ExecutionProposal]] = None) -> None:
+    """Raise VerificationError on any violated invariant."""
+    constraint = constraint or BalancingConstraint.default()
+    final = run.model
+    final.sanity_check()
+
+    # Replication factor unchanged for every partition (the optimizer moves
+    # replicas, it never creates/destroys them — verified like
+    # OptimizationVerifier's RF check).
+    rf0 = np.asarray(initial.partition_replication_factor())
+    rf1 = np.asarray(final.partition_replication_factor())
+    if not (rf0 == rf1).all():
+        bad = np.nonzero(rf0 != rf1)[0][:5]
+        raise VerificationError(f"replication factor changed for partitions {bad}")
+
+    # Total cluster load is conserved (moves relocate load, never change it).
+    load0 = np.asarray(initial.broker_load()).sum(axis=0)
+    load1 = np.asarray(final.broker_load()).sum(axis=0)
+    if not np.allclose(load0, load1, rtol=1e-4):
+        raise VerificationError(f"total load changed: {load0} -> {load1}")
+
+    # Hard goals must hold after optimization; soft goals must not have been
+    # *introduced* as violations (satisfied before ⇒ satisfied after).
+    arrays = BrokerArrays.from_model(final)
+    for spec, res in zip(goals_by_priority(goal_names), run.goal_results):
+        sat = bool(kernels.goal_satisfied(spec, final, arrays, constraint))
+        if spec.is_hard and not sat:
+            raise VerificationError(f"hard goal {spec.name} violated after optimization")
+        if res.satisfied_before and not sat:
+            raise VerificationError(f"goal {spec.name} regressed (was satisfied before)")
+
+    # No replicas may remain on dead brokers once hard goals ran.
+    dead = ~np.asarray(final.alive_broker_mask())
+    rb = np.asarray(final.replica_broker)
+    valid = np.asarray(final.replica_valid)
+    any_hard = any(s.is_hard for s in goals_by_priority(goal_names))
+    if any_hard and dead[rb[valid]].any():
+        raise VerificationError("replicas remain on dead brokers after hard goals")
+
+    if proposals is not None:
+        _verify_proposals(initial, final, proposals)
+
+
+def _verify_proposals(initial: TensorClusterModel, final: TensorClusterModel,
+                      proposals: List[ExecutionProposal]) -> None:
+    """Each proposal must be reachable from the initial distribution and
+    produce the final one (AnalyzerUtils.getDiff correctness)."""
+    for prop in proposals:
+        if len(prop.old_replicas) != len(prop.new_replicas):
+            raise VerificationError(
+                f"proposal for partition {prop.partition} changes RF")
+        old_brokers = sorted(p.broker for p in prop.old_replicas)
+        if len(set(old_brokers)) != len(old_brokers):
+            raise VerificationError(
+                f"proposal for partition {prop.partition} has duplicate old brokers")
+        new_brokers = sorted(p.broker for p in prop.new_replicas)
+        if len(set(new_brokers)) != len(new_brokers):
+            raise VerificationError(
+                f"proposal for partition {prop.partition} has duplicate new brokers")
+
+    # Final placement per partition matches what the proposals claim.
+    pr = np.asarray(final.partition_replicas)
+    rb1 = np.asarray(final.replica_broker)
+    by_part = {p.partition: p for p in proposals}
+    for part, prop in by_part.items():
+        slots = pr[part][pr[part] >= 0]
+        actual = sorted(int(rb1[r]) for r in slots)
+        claimed = sorted(p.broker for p in prop.new_replicas)
+        if actual != claimed:
+            raise VerificationError(
+                f"partition {part}: proposal claims brokers {claimed}, model has {actual}")

@@ -195,6 +195,20 @@ class TensorClusterModel:
         out = jnp.full((self.num_partitions,), -1, jnp.int32)
         return out.at[seg].max(jnp.where(mask, r_idx, -1))
 
+    def replica_offline_now(self) -> Array:
+        """bool[R] — replica is *currently* offline: it sits on a dead broker
+        or a dead disk (capacity < 0), or was reported offline by metadata at
+        model build (``replica_offline``) and has not moved since.  Derived
+        from placement rather than read directly, so moving a replica off
+        dead hardware heals it — matching the reference where a relocated
+        replica is a fresh online replica (Replica.java isCurrentOffline is
+        placement-scoped)."""
+        on_dead_broker = self.broker_state[self.replica_broker] == BrokerState.DEAD
+        disk_ids = jnp.where(self.replica_disk >= 0, self.replica_disk, 0)
+        on_dead_disk = (self.replica_disk >= 0) & (self.disk_capacity[disk_ids] < 0.0)
+        sticky = self.replica_offline & (self.replica_broker == self.replica_original_broker)
+        return (on_dead_broker | on_dead_disk | sticky) & self.replica_valid
+
     def alive_broker_mask(self) -> Array:
         """bool[B] brokers that can receive replicas (reference:
         ClusterModel.aliveBrokers — DEAD brokers excluded)."""
@@ -216,18 +230,23 @@ class TensorClusterModel:
         fixed-size batch apply only its accepted prefix under jit."""
         if apply_mask is None:
             apply_mask = jnp.ones(replica_ids.shape, bool)
-        # Masked-out slots write their current value back (no-op).
+        # Scatter-*add* of deltas: masked slots contribute 0, so duplicate
+        # replica ids across a candidate batch (same replica × many probed
+        # destinations, at most one selected) are well-defined — XLA leaves
+        # write order for duplicate-index scatter-set unspecified, which
+        # would let a masked no-op clobber the accepted write.  At most one
+        # unmasked entry per replica is the caller's contract.
         current = self.replica_broker[replica_ids]
-        new_vals = jnp.where(apply_mask, dest_brokers.astype(jnp.int32), current)
-        new_broker = self.replica_broker.at[replica_ids].set(new_vals)
+        delta = jnp.where(apply_mask, dest_brokers.astype(jnp.int32) - current, 0)
+        new_broker = self.replica_broker.at[replica_ids].add(delta)
         # An inter-broker move lands the replica on the destination broker's
         # default disk (the reference picks a destination logdir in the
         # proposal; intra-broker rebalancing then refines placement via
         # relocate_replicas_to_disk).
         cur_disk = self.replica_disk[replica_ids]
         dest_disk = self.broker_first_disk[dest_brokers.astype(jnp.int32)]
-        new_disk_vals = jnp.where(apply_mask, dest_disk, cur_disk)
-        new_disk = self.replica_disk.at[replica_ids].set(new_disk_vals)
+        disk_delta = jnp.where(apply_mask, dest_disk - cur_disk, 0)
+        new_disk = self.replica_disk.at[replica_ids].add(disk_delta)
         return self.replace(replica_broker=new_broker, replica_disk=new_disk)
 
     def relocate_leadership(self, src_replica_ids: Array, dest_replica_ids: Array,
@@ -237,12 +256,14 @@ class TensorClusterModel:
         ClusterModel.java:406)."""
         if apply_mask is None:
             apply_mask = jnp.ones(src_replica_ids.shape, bool)
-        lead = self.replica_is_leader
-        src_cur = lead[src_replica_ids]
-        dst_cur = lead[dest_replica_ids]
-        lead = lead.at[src_replica_ids].set(jnp.where(apply_mask, False, src_cur))
-        lead = lead.at[dest_replica_ids].set(jnp.where(apply_mask, True, dst_cur))
-        return self.replace(replica_is_leader=lead)
+        # Add-of-delta on an int view for the same duplicate-index reason as
+        # relocate_replicas: each applied transfer contributes -1 at the old
+        # leader and +1 at the new one; masked duplicates contribute 0.
+        lead = self.replica_is_leader.astype(jnp.int32)
+        d = apply_mask.astype(jnp.int32)
+        lead = lead.at[src_replica_ids].add(-d)
+        lead = lead.at[dest_replica_ids].add(d)
+        return self.replace(replica_is_leader=lead.astype(bool))
 
     def relocate_replicas_to_disk(self, replica_ids: Array, dest_disks: Array,
                                   apply_mask: Optional[Array] = None) -> "TensorClusterModel":
@@ -250,8 +271,8 @@ class TensorClusterModel:
         if apply_mask is None:
             apply_mask = jnp.ones(replica_ids.shape, bool)
         cur = self.replica_disk[replica_ids]
-        new_vals = jnp.where(apply_mask, dest_disks.astype(jnp.int32), cur)
-        return self.replace(replica_disk=self.replica_disk.at[replica_ids].set(new_vals))
+        delta = jnp.where(apply_mask, dest_disks.astype(jnp.int32) - cur, 0)
+        return self.replace(replica_disk=self.replica_disk.at[replica_ids].add(delta))
 
     def set_broker_state(self, broker_id: int, state: int) -> "TensorClusterModel":
         """Set a broker's liveness state (ClusterModel.setBrokerState).
