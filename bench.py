@@ -1,0 +1,84 @@
+"""Benchmark: wall-clock to a goal-satisfying rebalance proposal.
+
+Primary metric (BASELINE.json): candidate plans scored/sec/chip and
+wall-clock to a goal-satisfying proposal.  The north-star rung is a
+7k-broker / 1M-replica model in < 30 s on a v5e-8; this bench runs the
+ladder rung selected by ``BENCH_SCALE`` (small | mid | large | xl, default
+mid = 50 brokers / ~10k replicas, BASELINE.md ladder) with the full
+hard+soft goal stack, excludes compile time (one warm-up pass over cached
+compiled graphs), and prints exactly one JSON line:
+
+    {"metric": "...", "value": N, "unit": "...", "vs_baseline": N, ...}
+
+``vs_baseline`` is the speedup against the north-star 30 s budget scaled to
+the rung's replica count (30 s × replicas / 1M) — > 1.0 means faster than
+the scaled target.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+SCALES = {
+    # name: (brokers, racks, topics, mean parts/topic, rf)
+    "small": (3, 3, 5, 20.0, 3),       # ~300 partitions ladder rung
+    "mid": (50, 10, 40, 42.0, 3),      # ~50-broker / 10k-replica rung
+    "large": (200, 20, 100, 111.0, 3),  # ~200-broker / 100k-replica rung
+    "xl": (1000, 40, 200, 278.0, 3),   # stretch rung toward 7k/1M
+}
+
+STACK = [
+    "RackAwareGoal", "ReplicaCapacityGoal", "DiskCapacityGoal",
+    "NetworkInboundCapacityGoal", "NetworkOutboundCapacityGoal", "CpuCapacityGoal",
+    "ReplicaDistributionGoal", "PotentialNwOutGoal", "DiskUsageDistributionGoal",
+    "NetworkInboundUsageDistributionGoal", "NetworkOutboundUsageDistributionGoal",
+    "CpuUsageDistributionGoal", "TopicReplicaDistributionGoal",
+    "LeaderReplicaDistributionGoal", "LeaderBytesInDistributionGoal",
+]
+
+
+def main() -> None:
+    scale = os.environ.get("BENCH_SCALE", "mid")
+    brokers, racks, topics, ppt, rf = SCALES[scale]
+
+    from cruise_control_tpu.analyzer import optimizer as opt
+    from cruise_control_tpu.analyzer import proposals as props
+    from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster
+
+    spec = ClusterSpec(num_brokers=brokers, num_racks=racks, num_topics=topics,
+                       mean_partitions_per_topic=ppt, replication_factor=rf,
+                       distribution="exponential", seed=2026)
+    model = generate_cluster(spec)
+    num_replicas = int(model.replica_valid.sum())
+
+    # Warm-up: compile every goal graph (cached for the timed run).
+    opt.optimize(model, STACK, raise_on_hard_failure=False)
+
+    t0 = time.monotonic()
+    run = opt.optimize(model, STACK, raise_on_hard_failure=False)
+    proposals = props.diff(model, run.model)
+    wall_s = time.monotonic() - t0
+
+    hard_ok = all(g.satisfied_after for g in run.goal_results if g.is_hard)
+    plans_per_s = run.num_candidates_scored / max(wall_s, 1e-9)
+    # North-star budget scaled to this rung's replica count.
+    budget_s = 30.0 * num_replicas / 1_000_000
+    print(json.dumps({
+        "metric": f"wall_clock_to_goal_satisfying_proposal_{scale}",
+        "value": round(wall_s, 3),
+        "unit": "s",
+        "vs_baseline": round(budget_s / wall_s, 3),
+        "plans_scored_per_sec_per_chip": round(plans_per_s, 1),
+        "num_brokers": brokers,
+        "num_replicas": num_replicas,
+        "num_proposals": len(proposals),
+        "hard_goals_satisfied": hard_ok,
+        "candidates_scored": run.num_candidates_scored,
+    }))
+
+
+if __name__ == "__main__":
+    main()
