@@ -116,11 +116,14 @@ def select_nonconflicting(score: Array, cand: Candidates, eligible: Array,
 def _goal_step(model: TensorClusterModel, options: OptimizationOptions,
                spec: GoalSpec, prev_specs: Tuple[GoalSpec, ...],
                constraint: BalancingConstraint,
-               num_sources: int, num_dests: int):
+               num_sources: int, num_dests: int, mesh=None):
     """One optimization step for ``spec``: returns (new_model, num_applied).
 
-    Static args (spec, prev_specs, constraint, widths) select the compiled
-    graph; model/options are traced.
+    Static args (spec, prev_specs, constraint, widths, mesh) select the
+    compiled graph; model/options are traced.  With ``mesh`` set, the
+    candidate batch is sharding-constrained along its K axis so GSPMD
+    partitions the scoring/masking math across the mesh devices (see
+    parallel/mesh.py).
     """
     arrays = BrokerArrays.from_model(model)
 
@@ -134,6 +137,11 @@ def _goal_step(model: TensorClusterModel, options: OptimizationOptions,
     cand = batches[0]
     for extra in batches[1:]:
         cand = cgen.concat_candidates(cand, extra)
+    if mesh is not None:
+        sharding = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(mesh.axis_names[0]))
+        cand = jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(x, sharding), cand)
 
     feasible = kernels.self_feasible(spec, model, arrays, cand, constraint)
     accepted = jnp.ones_like(feasible)
@@ -152,13 +160,14 @@ _step_cache: Dict[tuple, object] = {}
 
 
 def _get_step_fn(spec: GoalSpec, prev_specs: Tuple[GoalSpec, ...],
-                 constraint: BalancingConstraint, num_sources: int, num_dests: int):
-    key = (spec, prev_specs, constraint, num_sources, num_dests)
+                 constraint: BalancingConstraint, num_sources: int, num_dests: int,
+                 mesh=None):
+    key = (spec, prev_specs, constraint, num_sources, num_dests, mesh)
     fn = _step_cache.get(key)
     if fn is None:
         fn = jax.jit(partial(_goal_step, spec=spec, prev_specs=prev_specs,
                              constraint=constraint, num_sources=num_sources,
-                             num_dests=num_dests))
+                             num_dests=num_dests, mesh=mesh))
         _step_cache[key] = fn
     return fn
 

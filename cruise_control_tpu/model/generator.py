@@ -43,9 +43,14 @@ class ClusterSpec:
     seed: int = 0
 
 
-def generate_cluster(spec: ClusterSpec, pad_replicas_to: Optional[int] = None) -> TensorClusterModel:
+def generate_cluster(spec: ClusterSpec, pad_replicas_to: Optional[int] = None,
+                     pad_replicas_to_multiple: Optional[int] = None) -> TensorClusterModel:
     """Generate a random cluster whose replicas are placed randomly (possibly
-    skewed), so distribution goals have work to do."""
+    skewed), so distribution goals have work to do.
+
+    ``pad_replicas_to_multiple`` rounds the replica axis up to a multiple
+    (e.g. the mesh size for replica-axis sharding) without the caller having
+    to build the model twice to learn R."""
     rng = np.random.default_rng(spec.seed)
     B = spec.num_brokers
     rf = spec.replication_factor
@@ -70,6 +75,9 @@ def generate_cluster(spec: ClusterSpec, pad_replicas_to: Optional[int] = None) -
     weights = weights / weights.sum()
 
     R = P * rf
+    if pad_replicas_to_multiple:
+        k = int(pad_replicas_to_multiple)
+        pad_replicas_to = max(pad_replicas_to or 0, ((R + k - 1) // k) * k)
     replica_partition = np.repeat(np.arange(P, dtype=np.int32), rf)
     replica_topic = partition_topic[replica_partition]
     replica_is_leader = (np.arange(R) % rf) == 0

@@ -4,17 +4,20 @@ The reference's only analyzer parallelism is a proposal-precompute thread
 pool (GoalOptimizer.java:114-116, `num.proposal.precompute.threads`).  The
 TPU-native scale axis is different: one optimizer step scores a K-wide
 candidate batch, and K shards cleanly across a device mesh — each chip
-scores K/n candidates against the (replicated) tensor model, and the
-conflict-free selection reduces globally.  This is data parallelism over
-*candidates* with XLA-inserted collectives riding ICI: we annotate shardings
-with ``NamedSharding`` / ``with_sharding_constraint`` and let GSPMD place
-the all-gathers (the scaling-book recipe: pick a mesh, annotate, let XLA
-insert collectives).
+scores K/n candidates against the tensor model, and the conflict-free
+selection reduces globally.  This is data parallelism over *candidates*
+with XLA-inserted collectives riding ICI: the step annotates shardings with
+``NamedSharding`` / ``with_sharding_constraint`` and lets GSPMD place the
+all-gathers (the scaling-book recipe: pick a mesh, annotate, let XLA insert
+collectives).
 
-For replica axes too large to replicate (the 1M-replica ladder rung), the
-model's replica-axis arrays shard over the same mesh; segment reductions
-onto the broker axis become scatter-adds followed by a psum, which XLA
-derives automatically from the sharding annotations.
+The step logic itself lives in ``optimizer._goal_step`` (one copy for the
+single-device and sharded paths; ``mesh`` is a static argument selecting
+the partitioned lowering).  For replica axes too large to replicate (the
+1M-replica ladder rung), ``shard_model_replica_axis`` places the R-axis
+arrays sharded over the same mesh; segment reductions onto the broker axis
+then lower to local scatter-adds followed by a psum, derived by XLA from
+the sharding annotations.
 
 Multi-chip hardware is not present in CI: tests and the driver's
 ``dryrun_multichip`` run this module on a virtual 8-device CPU mesh
@@ -24,8 +27,7 @@ GSPMD partitioning logic.
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -33,12 +35,10 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cruise_control_tpu.analyzer import candidates as cgen
-from cruise_control_tpu.analyzer.actions import apply_candidates
 from cruise_control_tpu.analyzer.balancing_constraint import BalancingConstraint
-from cruise_control_tpu.analyzer.goals import kernels
 from cruise_control_tpu.analyzer.goals.specs import GoalSpec
-from cruise_control_tpu.analyzer.optimizer import _MIN_SCORE, select_nonconflicting
-from cruise_control_tpu.analyzer.state import BrokerArrays, OptimizationOptions
+from cruise_control_tpu.analyzer.optimizer import _get_step_fn
+from cruise_control_tpu.analyzer.state import OptimizationOptions
 from cruise_control_tpu.model.tensor_model import TensorClusterModel
 
 SEARCH_AXIS = "search"
@@ -52,61 +52,15 @@ def make_search_mesh(num_devices: Optional[int] = None) -> Mesh:
     return Mesh(np.array(devs[:n]), (SEARCH_AXIS,))
 
 
-def _shard_candidates(cand, mesh: Mesh):
-    """Constrain every per-candidate array to shard along axis 0."""
-    sharding = NamedSharding(mesh, P(SEARCH_AXIS))
-    return jax.tree.map(
-        lambda x: jax.lax.with_sharding_constraint(x, sharding), cand)
-
-
-def sharded_goal_step(model: TensorClusterModel, options: OptimizationOptions,
-                      *, spec: GoalSpec, prev_specs: Tuple[GoalSpec, ...],
-                      constraint: BalancingConstraint, num_sources: int,
-                      num_dests: int, mesh: Mesh):
-    """One optimizer step with the candidate batch sharded over ``mesh``.
-
-    Mirrors optimizer._goal_step; the sharding constraint after candidate
-    assembly makes GSPMD partition the scoring/masking math (the dominant
-    FLOPs) across devices and gather only the K-length booleans/floats
-    needed for global selection.
-    """
-    arrays = BrokerArrays.from_model(model)
-    batches = []
-    if spec.uses_moves:
-        batches.append(cgen.move_candidates(spec, model, arrays, constraint, options,
-                                            num_sources, num_dests))
-    if spec.uses_leadership:
-        batches.append(cgen.leadership_candidates(spec, model, arrays, constraint,
-                                                  options, num_sources))
-    cand = batches[0]
-    for extra in batches[1:]:
-        cand = cgen.concat_candidates(cand, extra)
-    cand = _shard_candidates(cand, mesh)
-
-    feasible = kernels.self_feasible(spec, model, arrays, cand, constraint)
-    accepted = jnp.ones_like(feasible)
-    for prev in prev_specs:
-        accepted = accepted & kernels.accepts(prev, model, arrays, cand, constraint)
-    score = kernels.score(spec, model, arrays, cand, constraint)
-
-    eligible = cand.valid & feasible & accepted & (score > _MIN_SCORE)
-    keep = select_nonconflicting(score, cand, eligible, model.num_brokers,
-                                 model.num_partitions)
-    new_model = apply_candidates(model, cand, keep)
-    return new_model, keep.sum()
-
-
 def make_sharded_step(spec: GoalSpec, prev_specs: Tuple[GoalSpec, ...],
                       constraint: BalancingConstraint, num_sources: int,
                       num_dests: int, mesh: Mesh):
-    """Jit the sharded step.  Input arrays keep whatever placement the caller
-    chose (replicated model, or replica-axis-sharded via
-    ``shard_model_replica_axis``); the candidate-batch sharding constraint
-    inside the step drives GSPMD partitioning either way."""
-    fn = partial(sharded_goal_step, spec=spec, prev_specs=prev_specs,
-                 constraint=constraint, num_sources=num_sources,
-                 num_dests=num_dests, mesh=mesh)
-    return jax.jit(fn)
+    """Jitted optimizer step with mesh-sharded candidate scoring.  Cached on
+    (spec, prev_specs, constraint, widths, mesh) like the single-device
+    step.  Input arrays keep whatever placement the caller chose (replicated
+    model, or replica-axis-sharded via ``shard_model_replica_axis``)."""
+    return _get_step_fn(spec, prev_specs, constraint, num_sources, num_dests,
+                        mesh=mesh)
 
 
 def shard_model_replica_axis(model: TensorClusterModel, mesh: Mesh) -> TensorClusterModel:

@@ -1,0 +1,245 @@
+"""Monitor layer tests: aggregator windows/extrapolation, completeness,
+sample store replay, and end-to-end model generation.
+
+Mirrors the reference's MetricSampleAggregatorTest / RawMetricValuesTest
+(window eviction, extrapolation) and LoadMonitorTest patterns.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.monitor.aggregator import Extrapolation, MetricSampleAggregator
+from cruise_control_tpu.monitor.capacity import FileCapacityResolver, StaticCapacityResolver
+from cruise_control_tpu.monitor.load_monitor import (LoadMonitor, LoadMonitorState,
+                                                     ModelCompletenessRequirements,
+                                                     NotEnoughValidWindowsError)
+from cruise_control_tpu.monitor.metadata import (BrokerInfo, ClusterMetadata,
+                                                 MetadataClient, PartitionInfo)
+from cruise_control_tpu.monitor.sampling import (FileSampleStore, SamplingMode,
+                                                 SyntheticWorkloadSampler,
+                                                 assign_partitions)
+
+W = 300_000  # window ms
+
+
+def make_metadata(num_brokers=3, num_topics=2, parts_per_topic=4, rf=2):
+    brokers = tuple(BrokerInfo(broker_id=i, rack=f"r{i % 3}", host=f"h{i}")
+                    for i in range(num_brokers))
+    parts = []
+    for t in range(num_topics):
+        for p in range(parts_per_topic):
+            first = (t * parts_per_topic + p) % num_brokers
+            replicas = tuple((first + k) % num_brokers for k in range(rf))
+            parts.append(PartitionInfo(topic=f"topic{t}", partition=p,
+                                       leader=replicas[0], replicas=replicas))
+    return ClusterMetadata(brokers=brokers, partitions=tuple(parts))
+
+
+# -- aggregator ------------------------------------------------------------
+
+def test_window_rolling_and_eviction():
+    agg = MetricSampleAggregator(num_windows=3, window_ms=W)
+    for w in range(5):
+        agg.add_sample("e", w * W + 1, {"CPU_USAGE": float(w)})
+    # Current (in-progress) window = 4; completed retained = [1, 2, 3].
+    res = agg.aggregate()
+    assert res.values.shape[1] == 3
+    np.testing.assert_allclose(res.values[0, :, 0], [1.0, 2.0, 3.0])
+    # Samples older than retention are rejected.
+    assert not agg.add_sample("e", 0 * W + 2, {"CPU_USAGE": 9.0})
+
+
+def test_avg_available_extrapolation():
+    agg = MetricSampleAggregator(num_windows=4, window_ms=W, min_samples_per_window=4)
+    for w in range(3):
+        for s in range(4 if w != 1 else 2):   # window 1 has only half the samples
+            agg.add_sample("e", w * W + s, {"CPU_USAGE": 2.0})
+    agg.add_sample("e", 3 * W, {"CPU_USAGE": 0.0})  # open current window
+    res = agg.aggregate()
+    ords = list(Extrapolation)
+    assert ords[res.extrapolations[0, 1]] == Extrapolation.AVG_AVAILABLE
+    assert res.entity_valid[0]
+    np.testing.assert_allclose(res.values[0, 1, 0], 2.0)
+
+
+def test_avg_adjacent_extrapolation():
+    agg = MetricSampleAggregator(num_windows=3, window_ms=W)
+    agg.add_sample("e", 0 * W, {"CPU_USAGE": 1.0})
+    # window 1 empty
+    agg.add_sample("e", 2 * W, {"CPU_USAGE": 3.0})
+    agg.add_sample("e", 3 * W, {"CPU_USAGE": 0.0})  # current
+    res = agg.aggregate()
+    ords = list(Extrapolation)
+    assert ords[res.extrapolations[0, 1]] == Extrapolation.AVG_ADJACENT
+    np.testing.assert_allclose(res.values[0, 1, 0], 2.0)  # (1+3)/2
+
+
+def test_no_valid_extrapolation_invalidates_entity():
+    agg = MetricSampleAggregator(num_windows=3, window_ms=W)
+    agg.add_sample("e", 0 * W, {"CPU_USAGE": 1.0})
+    # windows 1 and 2 empty (adjacent fails for 2: right neighbor is current)
+    agg.add_sample("e", 3 * W, {"CPU_USAGE": 0.0})
+    res = agg.aggregate()
+    assert not res.entity_valid[0]
+
+
+def test_strategy_collapse_avg_max_latest():
+    agg = MetricSampleAggregator(num_windows=2, window_ms=W)
+    agg.add_sample("e", 0 * W + 1, {"CPU_USAGE": 1.0, "DISK_USAGE": 50.0,
+                                    "BROKER_REQUEST_QUEUE_SIZE": 5.0})
+    agg.add_sample("e", 0 * W + 2, {"CPU_USAGE": 3.0, "DISK_USAGE": 60.0,
+                                    "BROKER_REQUEST_QUEUE_SIZE": 1.0})
+    agg.add_sample("e", 1 * W + 1, {"CPU_USAGE": 5.0, "DISK_USAGE": 70.0,
+                                    "BROKER_REQUEST_QUEUE_SIZE": 2.0})
+    agg.add_sample("e", 2 * W, {"CPU_USAGE": 0.0})  # open current window
+    res = agg.aggregate()
+
+    def col(name):
+        from cruise_control_tpu.monitor.metricdef import KAFKA_METRIC_DEF
+        return res.collapsed[0, KAFKA_METRIC_DEF.metric_info(name).metric_id]
+
+    np.testing.assert_allclose(col("CPU_USAGE"), (2.0 + 5.0) / 2)   # AVG of window avgs
+    np.testing.assert_allclose(col("BROKER_REQUEST_QUEUE_SIZE"), 5.0)  # MAX
+    np.testing.assert_allclose(col("DISK_USAGE"), 70.0)             # LATEST
+
+
+def test_generation_advances_on_ingest():
+    agg = MetricSampleAggregator(num_windows=2, window_ms=W)
+    g0 = agg.generation
+    agg.add_sample("e", 1, {"CPU_USAGE": 1.0})
+    assert agg.generation > g0
+
+
+# -- sampling / store ------------------------------------------------------
+
+def test_partition_assignment_even_spread():
+    md = make_metadata(num_brokers=3, num_topics=6, parts_per_topic=5)
+    assignments = assign_partitions(md, 3)
+    sizes = [len(a) for a in assignments]
+    assert sum(sizes) == 30
+    assert max(sizes) - min(sizes) <= 5  # topic-granular spread
+
+
+def test_file_sample_store_roundtrip(tmp_path):
+    path = os.path.join(tmp_path, "samples.jsonl")
+    store = FileSampleStore(path)
+    md = make_metadata()
+    sampler = SyntheticWorkloadSampler()
+    samples = sampler.get_samples(md, [p.tp for p in md.partitions], 0, W)
+    store.store_samples(samples)
+    store.close()
+
+    store2 = FileSampleStore(path)
+    loaded = store2.load_samples()
+    assert len(loaded.partition_samples) == len(samples.partition_samples)
+    assert loaded.partition_samples[0].metrics == samples.partition_samples[0].metrics
+
+
+# -- load monitor end-to-end ----------------------------------------------
+
+def sampled_monitor(md=None, windows=3, store=None):
+    md = md or make_metadata()
+    lm = LoadMonitor(MetadataClient(md), StaticCapacityResolver(),
+                     sample_store=store,
+                     num_partition_windows=windows, partition_window_ms=W)
+    lm.start_up()
+    sampler = SyntheticWorkloadSampler()
+    for w in range(windows + 1):  # +1 opens the current window
+        lm.fetch_once(sampler, w * W, w * W + 1)
+    return lm
+
+
+def test_cluster_model_generation():
+    md = make_metadata(num_brokers=3, num_topics=2, parts_per_topic=4, rf=2)
+    lm = sampled_monitor(md)
+    assert lm.meets_completeness_requirements(
+        ModelCompletenessRequirements(min_required_num_windows=2,
+                                      min_monitored_partitions_percentage=0.9))
+    model = lm.cluster_model()
+    model.sanity_check()
+    assert model.num_brokers == 3
+    assert int(np.asarray(model.replica_valid).sum()) == md.replica_count()
+    # Leaders carry NW_OUT; follower rows must not.
+    load = np.asarray(model.replica_load())
+    leaders = np.asarray(model.replica_is_leader)
+    assert (load[~leaders][:, Resource.NW_OUT] == 0).all()
+    assert load[leaders][:, Resource.NW_OUT].sum() > 0
+
+
+def test_model_requires_windows():
+    md = make_metadata()
+    lm = LoadMonitor(MetadataClient(md), num_partition_windows=3,
+                     partition_window_ms=W)
+    lm.start_up()
+    with pytest.raises(NotEnoughValidWindowsError):
+        lm.cluster_model(ModelCompletenessRequirements(min_required_num_windows=1))
+
+
+def test_pause_resume_sampling():
+    md = make_metadata()
+    lm = LoadMonitor(MetadataClient(md), partition_window_ms=W)
+    lm.start_up()
+    lm.pause_sampling(reason="test")
+    assert lm.state() == LoadMonitorState.PAUSED
+    assert lm.fetch_once(SyntheticWorkloadSampler(), 0, 1) == 0
+    lm.resume_sampling()
+    assert lm.fetch_once(SyntheticWorkloadSampler(), 0, 1) > 0
+
+
+def test_sample_store_warm_start(tmp_path):
+    path = os.path.join(tmp_path, "s.jsonl")
+    lm = sampled_monitor(store=FileSampleStore(path))
+    gen_model = lm.cluster_model()
+
+    # New monitor replays the store on startup and can build the same model.
+    lm2 = LoadMonitor(MetadataClient(make_metadata()), StaticCapacityResolver(),
+                      sample_store=FileSampleStore(path),
+                      num_partition_windows=3, partition_window_ms=W)
+    lm2.start_up()
+    model2 = lm2.cluster_model()
+    np.testing.assert_allclose(np.asarray(gen_model.broker_load()),
+                               np.asarray(model2.broker_load()), rtol=1e-5)
+
+
+def test_dead_broker_marks_offline_replicas():
+    md = make_metadata()
+    dead = ClusterMetadata(
+        brokers=tuple(BrokerInfo(b.broker_id, b.rack, b.host, is_alive=(b.broker_id != 1))
+                      for b in md.brokers),
+        partitions=md.partitions)
+    lm = sampled_monitor(dead)
+    model = lm.cluster_model()
+    off = np.asarray(model.replica_offline_now())
+    rb = np.asarray(model.replica_broker)
+    valid = np.asarray(model.replica_valid)
+    assert (off[valid] == (rb[valid] == 1)).all()
+
+
+def test_bootstrap_fills_windows():
+    md = make_metadata()
+    lm = LoadMonitor(MetadataClient(md), num_partition_windows=4,
+                     partition_window_ms=W)
+    lm.start_up()
+    lm.bootstrap(SyntheticWorkloadSampler(), 0, 5 * W)
+    assert lm.partition_aggregator.valid_windows() >= 4
+    lm.cluster_model(ModelCompletenessRequirements(min_required_num_windows=4))
+
+
+def test_file_capacity_resolver():
+    doc = {"brokerCapacities": [
+        {"brokerId": "-1", "capacity": {"DISK": "500000", "CPU": "100",
+                                        "NW_IN": "50000", "NW_OUT": "50000"}},
+        {"brokerId": "0", "capacity": {"DISK": {"/d1": "250000", "/d2": "250000"},
+                                       "CPU": {"num.cores": "8"},
+                                       "NW_IN": "100000", "NW_OUT": "100000"}},
+    ]}
+    r = FileCapacityResolver(doc=doc)
+    b0 = r.capacity_for_broker("r0", "h0", 0)
+    assert b0.cpu == 800.0 and b0.disk == 500000.0 and len(b0.disk_by_logdir) == 2
+    b9 = r.capacity_for_broker("r0", "h9", 9)
+    assert b9.is_estimated and b9.disk == 500000.0
+    with pytest.raises(ValueError):
+        r.capacity_for_broker("r0", "h9", 9, allow_estimation=False)

@@ -22,9 +22,7 @@ def model():
                        mean_partitions_per_topic=12.0, replication_factor=2,
                        distribution="exponential", seed=13)
     # Pad the replica axis to a multiple of 8 so it can shard over the mesh.
-    m = generate_cluster(spec)
-    r = m.num_replicas_padded
-    return generate_cluster(spec, pad_replicas_to=((r + 7) // 8) * 8)
+    return generate_cluster(spec, pad_replicas_to_multiple=8)
 
 
 def test_mesh_has_eight_devices():
