@@ -1,0 +1,403 @@
+"""The Executor: applies proposals to the cluster with admission control.
+
+Parity with ``Executor`` (executor/Executor.java:76): owns the execution
+lifecycle — phases inter-broker → intra-broker → leadership
+(ProposalExecutionRunnable, Executor.java:1079-1148), per-batch reassignment
+submission + wait loop (interBrokerMoveReplicas :1255-1318,
+waitForExecutionTaskToFinish :1431), replication throttling around batches
+(ReplicationThrottleHelper), dead-broker task handling (:1548), graceful
+stop and force-stop (:91-96, znode deletion → ``cancel_reassignments``),
+recently-removed/demoted broker history (:113-117), the
+generating-proposals reservation handshake (:828), metric-sampling pause
+during execution (adjustSamplingModeBeforeExecution :1051-1067), and the
+concurrency auto-adjuster (:335-447).
+
+The executor is deliberately synchronous and poll-driven ("keep it boring"),
+driving any ``ClusterAdmin`` backend; the REST layer runs it on a worker
+thread.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.executor.admin import ClusterAdmin, ReassignmentRequest, Tp
+from cruise_control_tpu.executor.planner import ExecutionPlan, ExecutionTaskPlanner
+from cruise_control_tpu.executor.strategy import ReplicaMovementStrategy, StrategyContext
+from cruise_control_tpu.executor.task import ExecutionTask, TaskState, TaskType
+from cruise_control_tpu.executor.task_manager import (ConcurrencyLimits,
+                                                      ExecutionTaskManager)
+from cruise_control_tpu.executor.throttle import ReplicationThrottleHelper
+
+
+class ExecutorState(enum.Enum):
+    """executor/ExecutorState.java state machine."""
+
+    NO_TASK_IN_PROGRESS = "no_task_in_progress"
+    STARTING_EXECUTION = "starting_execution"
+    INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS = "inter_broker_replica_movement"
+    INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS = "intra_broker_replica_movement"
+    LEADER_MOVEMENT_TASK_IN_PROGRESS = "leader_movement"
+    STOPPING_EXECUTION = "stopping_execution"
+    GENERATING_PROPOSALS_FOR_EXECUTION = "generating_proposals_for_execution"
+
+
+class OngoingExecutionError(RuntimeError):
+    pass
+
+
+@dataclasses.dataclass
+class ExecutionResult:
+    completed: int
+    dead: int
+    aborted: int
+    polls: int
+    stopped: bool
+
+    @property
+    def ok(self) -> bool:
+        return not self.stopped and self.dead == 0 and self.aborted == 0
+
+
+class ConcurrencyAdjuster:
+    """Auto-scales movement concurrency from live broker metrics
+    (Executor.java:335-447 + ExecutionUtils thresholds): halves concurrency
+    when any broker looks stressed (deep request queue / low idle ratio or
+    (At/Under)MinISR partitions), doubles it (up to the configured cap) when
+    all brokers look healthy."""
+
+    REQUEST_QUEUE_SIZE_CAP = 1000.0
+    MIN_IDLE_RATIO = 0.3
+
+    def __init__(self, base: ConcurrencyLimits):
+        self._base = base
+
+    def adjust(self, limits: ConcurrencyLimits,
+               broker_metrics: Dict[int, Dict[str, float]],
+               has_min_isr_pressure: bool = False) -> ConcurrencyLimits:
+        stressed = has_min_isr_pressure
+        for m in broker_metrics.values():
+            if m.get("BROKER_REQUEST_QUEUE_SIZE", 0.0) > self.REQUEST_QUEUE_SIZE_CAP:
+                stressed = True
+            if m.get("BROKER_REQUEST_HANDLER_POOL_IDLE_PERCENT", 1.0) < self.MIN_IDLE_RATIO:
+                stressed = True
+        cur = limits.inter_broker_per_broker
+        if stressed:
+            new = max(1, cur // 2)
+        else:
+            new = min(self._base.inter_broker_per_broker, cur * 2)
+        return dataclasses.replace(limits, inter_broker_per_broker=new)
+
+
+class Executor:
+    def __init__(self, admin: ClusterAdmin,
+                 metadata_client,
+                 limits: Optional[ConcurrencyLimits] = None,
+                 strategy: Optional[ReplicaMovementStrategy] = None,
+                 throttle_rate_bytes_per_sec: Optional[int] = None,
+                 removed_broker_retention_ms: int = 12 * 3600 * 1000,
+                 on_sampling_pause: Optional[Callable[[str], None]] = None,
+                 on_sampling_resume: Optional[Callable[[], None]] = None,
+                 logdir_by_disk: Optional[Dict[int, str]] = None):
+        self._admin = admin
+        self._metadata = metadata_client
+        self._limits = limits or ConcurrencyLimits()
+        self._strategy = strategy
+        self._throttle = ReplicationThrottleHelper(admin, throttle_rate_bytes_per_sec)
+        self._lock = threading.RLock()
+        self._state = ExecutorState.NO_TASK_IN_PROGRESS
+        self._stop_requested = False
+        self._force_stop = False
+        self._reserved_for_proposals = False
+        self._retention_ms = removed_broker_retention_ms
+        self._recently_removed: Dict[int, int] = {}   # broker → time_ms
+        self._recently_demoted: Dict[int, int] = {}
+        self._on_pause = on_sampling_pause
+        self._on_resume = on_sampling_resume
+        self._logdir_by_disk = logdir_by_disk or {}
+        self._task_manager: Optional[ExecutionTaskManager] = None
+        self._adjuster = ConcurrencyAdjuster(self._limits)
+
+    # -- state -------------------------------------------------------------
+    def state(self) -> ExecutorState:
+        with self._lock:
+            return self._state
+
+    @property
+    def has_ongoing_execution(self) -> bool:
+        return self.state() not in (ExecutorState.NO_TASK_IN_PROGRESS,
+                                    ExecutorState.GENERATING_PROPOSALS_FOR_EXECUTION)
+
+    def state_summary(self) -> Dict[str, object]:
+        with self._lock:
+            out: Dict[str, object] = {"state": self._state.value}
+            if self._task_manager is not None:
+                out["tasks"] = self._task_manager.counts()
+            out["recentlyRemovedBrokers"] = sorted(self.recently_removed_brokers())
+            out["recentlyDemotedBrokers"] = sorted(self.recently_demoted_brokers())
+            return out
+
+    # -- reservation handshake (Executor.java:828) --------------------------
+    def set_generating_proposals_for_execution(self) -> None:
+        with self._lock:
+            if self._state != ExecutorState.NO_TASK_IN_PROGRESS:
+                raise OngoingExecutionError(
+                    f"cannot reserve executor in state {self._state}")
+            self._state = ExecutorState.GENERATING_PROPOSALS_FOR_EXECUTION
+            self._reserved_for_proposals = True
+
+    def failed_generating_proposals_for_execution(self) -> None:
+        with self._lock:
+            if self._reserved_for_proposals:
+                self._reserved_for_proposals = False
+                self._state = ExecutorState.NO_TASK_IN_PROGRESS
+
+    # -- stop signals -------------------------------------------------------
+    def stop_execution(self, force: bool = False) -> None:
+        with self._lock:
+            if self.has_ongoing_execution:
+                self._stop_requested = True
+                self._force_stop = force
+                self._state = ExecutorState.STOPPING_EXECUTION
+        if force:
+            self._admin.cancel_reassignments()
+
+    # -- broker history ------------------------------------------------------
+    def _gc_history(self, history: Dict[int, int], now_ms: int) -> None:
+        expired = [b for b, t in history.items() if now_ms - t > self._retention_ms]
+        for b in expired:
+            del history[b]
+
+    def add_recently_removed_brokers(self, brokers: Sequence[int],
+                                     now_ms: Optional[int] = None) -> None:
+        now = now_ms if now_ms is not None else int(time.time() * 1000)
+        with self._lock:
+            for b in brokers:
+                self._recently_removed[b] = now
+
+    def add_recently_demoted_brokers(self, brokers: Sequence[int],
+                                     now_ms: Optional[int] = None) -> None:
+        now = now_ms if now_ms is not None else int(time.time() * 1000)
+        with self._lock:
+            for b in brokers:
+                self._recently_demoted[b] = now
+
+    def drop_recently_removed_brokers(self, brokers: Sequence[int]) -> None:
+        with self._lock:
+            for b in brokers:
+                self._recently_removed.pop(b, None)
+
+    def recently_removed_brokers(self, now_ms: Optional[int] = None) -> Set[int]:
+        now = now_ms if now_ms is not None else int(time.time() * 1000)
+        with self._lock:
+            self._gc_history(self._recently_removed, now)
+            return set(self._recently_removed)
+
+    def recently_demoted_brokers(self, now_ms: Optional[int] = None) -> Set[int]:
+        now = now_ms if now_ms is not None else int(time.time() * 1000)
+        with self._lock:
+            self._gc_history(self._recently_demoted, now)
+            return set(self._recently_demoted)
+
+    # -- main entry ----------------------------------------------------------
+    def execute_proposals(self, proposals: Sequence[ExecutionProposal],
+                          partition_names: Sequence[Tp],
+                          context: Optional[StrategyContext] = None,
+                          max_polls: int = 10_000,
+                          poll_interval_s: float = 0.0,
+                          concurrency_adjust_metrics: Optional[
+                              Callable[[], Dict[int, Dict[str, float]]]] = None
+                          ) -> ExecutionResult:
+        """Run the full three-phase execution to completion.
+
+        ``partition_names[p.partition]`` maps a proposal's dense partition id
+        to its (topic, partition) — the naming seam between the tensor world
+        and the cluster protocol.
+        """
+        with self._lock:
+            if self.has_ongoing_execution:
+                raise OngoingExecutionError("an execution is already in progress")
+            if self._admin.ongoing_reassignments():
+                raise OngoingExecutionError(
+                    "ongoing partition reassignments detected (started by another "
+                    "tool or a previous run) — refusing to execute; force-stop to adopt")
+            self._state = ExecutorState.STARTING_EXECUTION
+            self._stop_requested = False
+            self._force_stop = False
+            self._reserved_for_proposals = False
+
+        if self._on_pause:
+            self._on_pause("ongoing execution")
+        try:
+            planner = ExecutionTaskPlanner(self._strategy)
+            plan = planner.plan(proposals, context)
+            tm = ExecutionTaskManager(plan, self._limits)
+            with self._lock:
+                self._task_manager = tm
+            polls = 0
+            stopped = False
+
+            # Phase 1: inter-broker replica movement (throttled).
+            if plan.inter_broker_tasks and not stopped:
+                with self._lock:
+                    self._state = ExecutorState.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
+                involved = sorted({b for t in plan.inter_broker_tasks
+                                   for b in t.brokers_involved()})
+                self._throttle.set_throttles(plan.inter_broker_tasks, partition_names)
+                try:
+                    polls, stopped = self._run_inter_broker_phase(
+                        tm, partition_names, max_polls, poll_interval_s,
+                        concurrency_adjust_metrics)
+                finally:
+                    self._throttle.clear_throttles(plan.inter_broker_tasks,
+                                                   partition_names)
+
+            # Phase 2: intra-broker (logdir) movement.
+            if plan.intra_broker_tasks and not stopped and not self._stop_requested:
+                with self._lock:
+                    self._state = ExecutorState.INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
+                self._run_intra_broker_phase(tm, partition_names)
+
+            # Phase 3: leadership movement (batched preferred elections).
+            if plan.leadership_tasks and not stopped and not self._stop_requested:
+                with self._lock:
+                    self._state = ExecutorState.LEADER_MOVEMENT_TASK_IN_PROGRESS
+                self._run_leadership_phase(tm, partition_names, max_polls,
+                                           poll_interval_s)
+
+            stopped = stopped or self._stop_requested
+            buckets = tm.tasks_by_state()
+            return ExecutionResult(
+                completed=len(buckets[TaskState.COMPLETED]),
+                dead=len(buckets[TaskState.DEAD]),
+                aborted=len(buckets[TaskState.ABORTED]),
+                polls=polls, stopped=stopped)
+        finally:
+            with self._lock:
+                self._state = ExecutorState.NO_TASK_IN_PROGRESS
+            if self._on_resume:
+                self._on_resume()
+
+    # -- phases --------------------------------------------------------------
+    def _target_replicas(self, task: ExecutionTask) -> Tuple[int, ...]:
+        return tuple(r.broker for r in task.proposal.new_replicas)
+
+    def _run_inter_broker_phase(self, tm: ExecutionTaskManager,
+                                partition_names: Sequence[Tp], max_polls: int,
+                                poll_interval_s: float,
+                                metrics_fn) -> Tuple[int, bool]:
+        submitted: Dict[int, ExecutionTask] = {}
+        polls = 0
+        while polls < max_polls:
+            if self._stop_requested:
+                # Graceful stop: let in-flight tasks finish, admit no more;
+                # force-stop also cancels in-flight (handled via admin above).
+                for t in list(submitted.values()):
+                    if self._force_stop and t.state == TaskState.IN_PROGRESS:
+                        t.aborting()
+                        t.aborted()
+                        tm.finished(t)
+                        del submitted[t.execution_id]
+                if self._force_stop:
+                    return polls, True
+            else:
+                new_tasks = tm.next_inter_broker_tasks()
+                if new_tasks:
+                    reqs = []
+                    for t in new_tasks:
+                        t.in_progress()
+                        submitted[t.execution_id] = t
+                        reqs.append(ReassignmentRequest(
+                            tp=partition_names[t.proposal.partition],
+                            new_replicas=self._target_replicas(t)))
+                    self._admin.alter_partition_reassignments(reqs)
+
+            ongoing = self._admin.ongoing_reassignments()
+            cluster = self._metadata.cluster()
+            by_tp = {p.tp: p for p in cluster.partitions}
+            alive = set(cluster.alive_broker_ids())
+            for t in list(submitted.values()):
+                tp = tuple(partition_names[t.proposal.partition])
+                target = set(self._target_replicas(t))
+                part = by_tp.get(tp)
+                if tp not in ongoing and part is not None and \
+                        set(part.replicas) == target:
+                    t.completed()
+                    tm.finished(t)
+                    del submitted[t.execution_id]
+                elif not target <= alive:
+                    # Destination broker died mid-move (Executor.java:1548).
+                    if t.state == TaskState.IN_PROGRESS:
+                        t.kill()
+                        tm.finished(t)
+                        self._admin.cancel_reassignments([tp])
+                        del submitted[t.execution_id]
+            polls += 1
+            if metrics_fn is not None:
+                tm.set_limits(self._adjuster.adjust(tm.limits, metrics_fn()))
+            if not submitted:
+                pending = [t for t in tm._plan.inter_broker_tasks
+                           if t.state == TaskState.PENDING]
+                if not pending or self._stop_requested:
+                    return polls, False
+            if poll_interval_s:
+                time.sleep(poll_interval_s)
+        return polls, True
+
+    def _run_intra_broker_phase(self, tm: ExecutionTaskManager,
+                                partition_names: Sequence[Tp]) -> None:
+        while True:
+            tasks = tm.next_intra_broker_tasks()
+            if not tasks:
+                break
+            moves = []
+            for t in tasks:
+                t.in_progress()
+                for broker, _old_disk, new_disk in t.proposal._intra_broker_moves():
+                    logdir = self._logdir_by_disk.get(new_disk, f"/logdir-{new_disk}")
+                    moves.append((partition_names[t.proposal.partition], broker, logdir))
+            self._admin.alter_replica_logdirs(moves)
+            for t in tasks:
+                t.completed()
+                tm.finished(t)
+
+    def _run_leadership_phase(self, tm: ExecutionTaskManager,
+                              partition_names: Sequence[Tp],
+                              max_polls: int = 10_000,
+                              poll_interval_s: float = 0.0) -> None:
+        while not self._stop_requested:
+            tasks = tm.next_leadership_tasks()
+            if not tasks:
+                break
+            # Make the proposal's leader the preferred replica then trigger a
+            # batched preferred-leader election (moveLeaderships,
+            # Executor.java:1373-1399).
+            reqs = [ReassignmentRequest(tp=partition_names[t.proposal.partition],
+                                        new_replicas=self._target_replicas(t))
+                    for t in tasks]
+            for t in tasks:
+                t.in_progress()
+            self._admin.alter_partition_reassignments(reqs)
+            polls = 0
+            while self._admin.ongoing_reassignments() and polls < max_polls \
+                    and not self._force_stop:
+                polls += 1
+                if poll_interval_s:
+                    time.sleep(poll_interval_s)
+            timed_out = polls >= max_polls or self._force_stop
+            if not timed_out:
+                self._admin.elect_leaders([partition_names[t.proposal.partition]
+                                           for t in tasks])
+            for t in tasks:
+                if timed_out:
+                    t.kill()
+                else:
+                    t.completed()
+                tm.finished(t)
+            if timed_out:
+                break

@@ -1,0 +1,105 @@
+"""Execution tasks and their state machine.
+
+Parity with ``ExecutionTask``/``ExecutionTaskState``
+(executor/ExecutionTask.java:41, ExecutionTaskState.java): a task wraps one
+``ExecutionProposal`` with an execution id and a type, and walks
+PENDING → IN_PROGRESS → {COMPLETED | ABORTING → ABORTED | DEAD}.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from typing import Optional
+
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+
+
+class TaskType(enum.Enum):
+    """executor/ExecutionTask.TaskType."""
+
+    INTER_BROKER_REPLICA_ACTION = "inter_broker_replica_action"
+    INTRA_BROKER_REPLICA_ACTION = "intra_broker_replica_action"
+    LEADER_ACTION = "leader_action"
+
+
+class TaskState(enum.Enum):
+    """executor/ExecutionTaskState.java."""
+
+    PENDING = "pending"
+    IN_PROGRESS = "in_progress"
+    ABORTING = "aborting"
+    ABORTED = "aborted"
+    DEAD = "dead"
+    COMPLETED = "completed"
+
+
+_VALID_TRANSITIONS = {
+    TaskState.PENDING: {TaskState.IN_PROGRESS},
+    TaskState.IN_PROGRESS: {TaskState.ABORTING, TaskState.DEAD, TaskState.COMPLETED},
+    TaskState.ABORTING: {TaskState.ABORTED, TaskState.DEAD},
+    TaskState.ABORTED: set(),
+    TaskState.DEAD: set(),
+    TaskState.COMPLETED: set(),
+}
+
+
+@dataclasses.dataclass
+class ExecutionTask:
+    execution_id: int
+    proposal: ExecutionProposal
+    task_type: TaskType
+    state: TaskState = TaskState.PENDING
+    start_time_ms: int = -1
+    end_time_ms: int = -1
+    alert_time_ms: int = -1
+
+    def _transition(self, to: TaskState, now_ms: Optional[int] = None) -> None:
+        if to not in _VALID_TRANSITIONS[self.state]:
+            raise ValueError(f"illegal task transition {self.state} -> {to} "
+                             f"(task {self.execution_id})")
+        self.state = to
+        now = now_ms if now_ms is not None else int(time.time() * 1000)
+        if to == TaskState.IN_PROGRESS:
+            self.start_time_ms = now
+        elif to in (TaskState.COMPLETED, TaskState.ABORTED, TaskState.DEAD):
+            self.end_time_ms = now
+
+    def in_progress(self, now_ms: Optional[int] = None) -> None:
+        self._transition(TaskState.IN_PROGRESS, now_ms)
+
+    def completed(self, now_ms: Optional[int] = None) -> None:
+        self._transition(TaskState.COMPLETED, now_ms)
+
+    def aborting(self, now_ms: Optional[int] = None) -> None:
+        self._transition(TaskState.ABORTING, now_ms)
+
+    def aborted(self, now_ms: Optional[int] = None) -> None:
+        self._transition(TaskState.ABORTED, now_ms)
+
+    def kill(self, now_ms: Optional[int] = None) -> None:
+        self._transition(TaskState.DEAD, now_ms)
+
+    @property
+    def is_active(self) -> bool:
+        return self.state in (TaskState.PENDING, TaskState.IN_PROGRESS,
+                              TaskState.ABORTING)
+
+    def brokers_involved(self):
+        """Brokers this task touches (source + destination sets)."""
+        p = self.proposal
+        if self.task_type == TaskType.LEADER_ACTION:
+            return {p.old_leader.broker, p.new_leader.broker}
+        out = set(p.replicas_to_add) | set(p.replicas_to_remove)
+        if self.task_type == TaskType.INTRA_BROKER_REPLICA_ACTION:
+            out |= {b for b, _, _ in p._intra_broker_moves()}
+        return out
+
+    def to_dict(self) -> dict:
+        return {
+            "executionId": self.execution_id,
+            "type": self.task_type.value,
+            "state": self.state.value,
+            "proposal": self.proposal.to_dict(),
+        }

@@ -57,6 +57,9 @@ class AggregationResult:
     extrapolations: np.ndarray  # i8[E, W] Extrapolation ordinal
     window_starts_ms: np.ndarray  # i64[W] oldest → newest
     generation: int
+    # Entity keys in row order, snapshotted under the aggregator lock so row
+    # indices always match the arrays even with concurrent ingestion.
+    entities: list = dataclasses.field(default_factory=list)
 
     def completeness(self) -> float:
         """Fraction of entities with a valid aggregate
@@ -149,19 +152,31 @@ class MetricSampleAggregator:
 
     def _roll_to(self, window_index: int) -> None:
         """Advance the cyclic buffer so ``window_index`` is current; evicted
-        slots are zeroed (O(1) per new window — WindowIndexedArrays)."""
-        while self._current_window_index < window_index:
-            self._current_window_index += 1
-            slot = self._slot(self._current_window_index)
-            self._sum[:, slot] = 0.0
-            self._max[:, slot] = -np.inf
-            self._latest_val[:, slot] = 0.0
-            self._latest_ts[:, slot] = -1
-            self._count[:, slot] = 0
-            new_oldest = self._current_window_index - self._w
-            if new_oldest > self._oldest_window_index:
-                self._oldest_window_index = new_oldest
-            self._generation += 1
+        slots are zeroed.  Bounded by the buffer size, not the gap: a jump
+        larger than W+1 windows (e.g. the first real epoch-ms sample on a
+        fresh aggregator) evicts every slot at once instead of iterating
+        millions of empty windows."""
+        gap = window_index - self._current_window_index
+        if gap <= 0:
+            return
+        if gap > self._w + 1:
+            self._sum[:] = 0.0
+            self._max[:] = -np.inf
+            self._latest_val[:] = 0.0
+            self._latest_ts[:] = -1
+            self._count[:] = 0
+        else:
+            for i in range(self._current_window_index + 1, window_index + 1):
+                slot = self._slot(i)
+                self._sum[:, slot] = 0.0
+                self._max[:, slot] = -np.inf
+                self._latest_val[:, slot] = 0.0
+                self._latest_ts[:, slot] = -1
+                self._count[:, slot] = 0
+        self._current_window_index = window_index
+        self._oldest_window_index = max(self._oldest_window_index,
+                                        window_index - self._w)
+        self._generation += 1
 
     def add_sample(self, entity, time_ms: int, values: Dict[str, float]) -> bool:
         """Record one sample.  Returns False for samples older than the
@@ -220,7 +235,8 @@ class MetricSampleAggregator:
                     window_valid=np.zeros((e, w), bool),
                     extrapolations=np.zeros((e, w), np.int8),
                     window_starts_ms=np.arange(w, dtype=np.int64),
-                    generation=self._generation)
+                    generation=self._generation,
+                    entities=self.entities)
 
             s = self._sum[:e][:, slots]          # [E, W, M]
             mx = self._max[:e][:, slots]
@@ -291,7 +307,8 @@ class MetricSampleAggregator:
                 window_valid=window_valid,
                 extrapolations=extrap,
                 window_starts_ms=starts,
-                generation=self._generation)
+                generation=self._generation,
+                entities=self.entities[:e])
 
     def valid_windows(self) -> int:
         """Number of completed windows currently retained."""

@@ -226,7 +226,9 @@ class LoadMonitor:
     def _build_model(self, agg: AggregationResult, allow_capacity_estimation: bool,
                      pad_replicas_to: Optional[int]) -> TensorClusterModel:
         cluster = self._metadata.cluster()
-        entity_rows = {e: i for i, e in enumerate(self.partition_aggregator.entities)}
+        # Row map from the aggregation snapshot itself (not the live aggregator),
+        # so concurrently registered entities cannot index past the arrays.
+        entity_rows = {e: i for i, e in enumerate(agg.entities)}
 
         topics = cluster.topics()
         topic_id = {t: i for i, t in enumerate(topics)}
@@ -314,8 +316,9 @@ class LoadMonitor:
         """Dense-id ↔ name maps the REST layer uses to render proposals."""
         cluster = self._metadata.cluster()
         topics = cluster.topics()
+        topic_id = {t: i for i, t in enumerate(topics)}
         parts = sorted(cluster.partitions,
-                       key=lambda p: (topics.index(p.topic), p.partition))
+                       key=lambda p: (topic_id[p.topic], p.partition))
         return {
             "topics": topics,
             "partitions": [p.tp for p in parts],

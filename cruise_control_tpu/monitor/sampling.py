@@ -110,8 +110,14 @@ class SyntheticWorkloadSampler(MetricSampler):
         bsamples: List[BrokerMetricSample] = []
         by_tp = {p.tp: p for p in cluster.partitions}
         t = end_ms
-        if mode in (SamplingMode.ALL, SamplingMode.PARTITION_METRICS_ONLY,
-                    SamplingMode.ONGOING_EXECUTION):
+        want_partitions = mode in (SamplingMode.ALL, SamplingMode.PARTITION_METRICS_ONLY,
+                                   SamplingMode.ONGOING_EXECUTION)
+        want_brokers = mode in (SamplingMode.ALL, SamplingMode.BROKER_METRICS_ONLY)
+        # Broker CPU derives from the leaders' workloads, so compute the
+        # per-partition rows regardless of mode and only *emit* them when the
+        # mode asks for partition samples.
+        per_broker_cpu: Dict[int, float] = {}
+        if want_partitions or want_brokers:
             for tp in partitions:
                 info = by_tp.get(tuple(tp))
                 if info is None or info.leader < 0:
@@ -119,24 +125,23 @@ class SyntheticWorkloadSampler(MetricSampler):
                 s = self._partition_scale(*tp)
                 nw_in = self._nw * s
                 nw_out = 1.4 * self._nw * s
-                psamples.append(PartitionMetricSample(
-                    topic=tp[0], partition=tp[1], broker_id=info.leader, time_ms=t,
-                    metrics={
-                        "CPU_USAGE": self._cpu_per_kb * (nw_in + nw_out),
-                        "DISK_USAGE": self._disk * s,
-                        "LEADER_BYTES_IN": nw_in,
-                        "LEADER_BYTES_OUT": nw_out,
-                        "PRODUCE_RATE": 10.0 * s,
-                        "FETCH_RATE": 14.0 * s,
-                        "MESSAGE_IN_RATE": 100.0 * s,
-                        "REPLICATION_BYTES_IN_RATE": nw_in * (len(info.replicas) - 1),
-                        "REPLICATION_BYTES_OUT_RATE": nw_in * (len(info.replicas) - 1),
-                    }))
-        if mode in (SamplingMode.ALL, SamplingMode.BROKER_METRICS_ONLY):
-            per_broker_cpu: Dict[int, float] = {}
-            for ps in psamples:
-                per_broker_cpu[ps.broker_id] = per_broker_cpu.get(ps.broker_id, 0.0) \
-                    + ps.metrics["CPU_USAGE"]
+                cpu = self._cpu_per_kb * (nw_in + nw_out)
+                per_broker_cpu[info.leader] = per_broker_cpu.get(info.leader, 0.0) + cpu
+                if want_partitions:
+                    psamples.append(PartitionMetricSample(
+                        topic=tp[0], partition=tp[1], broker_id=info.leader, time_ms=t,
+                        metrics={
+                            "CPU_USAGE": cpu,
+                            "DISK_USAGE": self._disk * s,
+                            "LEADER_BYTES_IN": nw_in,
+                            "LEADER_BYTES_OUT": nw_out,
+                            "PRODUCE_RATE": 10.0 * s,
+                            "FETCH_RATE": 14.0 * s,
+                            "MESSAGE_IN_RATE": 100.0 * s,
+                            "REPLICATION_BYTES_IN_RATE": nw_in * (len(info.replicas) - 1),
+                            "REPLICATION_BYTES_OUT_RATE": nw_in * (len(info.replicas) - 1),
+                        }))
+        if want_brokers:
             for b in cluster.brokers:
                 if not b.is_alive:
                     continue

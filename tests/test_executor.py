@@ -1,0 +1,240 @@
+"""Executor tests: planning, strategies, admission, three-phase execution
+against the in-memory cluster admin (the ExecutorTest translation — real
+reassignments against the fake backend instead of embedded brokers).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import optimizer as opt, proposals as props
+from cruise_control_tpu.executor.admin import InMemoryClusterAdmin, ReassignmentRequest
+from cruise_control_tpu.executor.executor import (ExecutionResult, Executor,
+                                                  ExecutorState, OngoingExecutionError)
+from cruise_control_tpu.executor.planner import ExecutionTaskPlanner
+from cruise_control_tpu.executor.strategy import (PostponeUrpReplicaMovementStrategy,
+                                                  PrioritizeLargeReplicaMovementStrategy,
+                                                  PrioritizeSmallReplicaMovementStrategy,
+                                                  StrategyContext, resolve_strategy)
+from cruise_control_tpu.executor.task import TaskState, TaskType
+from cruise_control_tpu.executor.task_manager import ConcurrencyLimits, ExecutionTaskManager
+from cruise_control_tpu.monitor.capacity import StaticCapacityResolver
+from cruise_control_tpu.monitor.load_monitor import LoadMonitor
+from cruise_control_tpu.monitor.metadata import (BrokerInfo, ClusterMetadata,
+                                                 MetadataClient, PartitionInfo)
+from cruise_control_tpu.monitor.sampling import SyntheticWorkloadSampler
+
+W = 300_000
+
+
+def build_cluster(num_brokers=4, num_topics=2, parts_per_topic=6, rf=2, seed=3):
+    rng = np.random.default_rng(seed)
+    brokers = tuple(BrokerInfo(i, rack=f"r{i % 2}", host=f"h{i}")
+                    for i in range(num_brokers))
+    # Skewed placement so the optimizer produces movements.
+    w = np.linspace(1.0, 4.0, num_brokers)
+    w = w / w.sum()
+    parts = []
+    for t in range(num_topics):
+        for p in range(parts_per_topic):
+            reps = tuple(int(x) for x in rng.choice(num_brokers, rf, replace=False, p=w))
+            parts.append(PartitionInfo(f"t{t}", p, leader=reps[0], replicas=reps))
+    return ClusterMetadata(brokers=brokers, partitions=tuple(parts))
+
+
+def monitored(md, windows=3):
+    mc = MetadataClient(md)
+    lm = LoadMonitor(mc, StaticCapacityResolver(), num_partition_windows=windows,
+                     partition_window_ms=W)
+    lm.start_up()
+    s = SyntheticWorkloadSampler()
+    for wdx in range(windows + 1):
+        lm.fetch_once(s, wdx * W, wdx * W + 1)
+    return mc, lm
+
+
+def optimize_proposals(lm):
+    model = lm.cluster_model()
+    run = opt.optimize(model, ["ReplicaDistributionGoal", "LeaderReplicaDistributionGoal"],
+                       raise_on_hard_failure=False)
+    return model, props.diff(model, run.model)
+
+
+# -- strategies -------------------------------------------------------------
+
+def make_proposal(partition, size, old=(0, 1), new=(2, 1)):
+    from cruise_control_tpu.analyzer.proposals import ExecutionProposal, ReplicaPlacement
+    return ExecutionProposal(
+        partition=partition, topic=0, partition_size=size,
+        old_leader=ReplicaPlacement(old[0]),
+        old_replicas=tuple(ReplicaPlacement(b) for b in old),
+        new_replicas=tuple(ReplicaPlacement(b) for b in new))
+
+
+def test_strategy_ordering():
+    planner = ExecutionTaskPlanner(PrioritizeLargeReplicaMovementStrategy())
+    plan = planner.plan([make_proposal(0, 10.0), make_proposal(1, 99.0),
+                         make_proposal(2, 50.0)])
+    sizes = [t.proposal.partition_size for t in plan.inter_broker_tasks]
+    assert sizes == [99.0, 50.0, 10.0]
+
+    planner = ExecutionTaskPlanner(PrioritizeSmallReplicaMovementStrategy())
+    plan = planner.plan([make_proposal(0, 10.0), make_proposal(1, 99.0)])
+    assert [t.proposal.partition_size for t in plan.inter_broker_tasks] == [10.0, 99.0]
+
+
+def test_strategy_chaining_postpone_urp():
+    strat = PostponeUrpReplicaMovementStrategy().chain(
+        PrioritizeLargeReplicaMovementStrategy())
+    planner = ExecutionTaskPlanner(strat)
+    ctx = StrategyContext(under_replicated={1})
+    plan = planner.plan([make_proposal(0, 10.0), make_proposal(1, 99.0),
+                         make_proposal(2, 50.0)], ctx)
+    order = [t.proposal.partition for t in plan.inter_broker_tasks]
+    assert order == [2, 0, 1]  # URP partition 1 postponed; others large-first
+
+
+def test_resolve_strategy_chain():
+    s = resolve_strategy(["postpone-urp", "prioritize-large"])
+    assert "postpone-urp" in s.name and "prioritize-large" in s.name
+    with pytest.raises(ValueError):
+        resolve_strategy(["nope"])
+
+
+# -- task manager ------------------------------------------------------------
+
+def test_concurrency_admission():
+    planner = ExecutionTaskPlanner()
+    proposals = [make_proposal(i, 1.0, old=(0, 1), new=(2, 1)) for i in range(8)]
+    plan = planner.plan(proposals)
+    tm = ExecutionTaskManager(plan, ConcurrencyLimits(inter_broker_per_broker=3))
+    batch1 = tm.next_inter_broker_tasks()
+    assert len(batch1) == 3  # brokers 0/2 gated at 3 concurrent moves
+    assert tm.next_inter_broker_tasks() == []
+    for t in batch1:
+        t.in_progress()
+        t.completed()
+        tm.finished(t)
+    batch2 = tm.next_inter_broker_tasks()
+    assert len(batch2) == 3
+
+
+def test_cluster_movement_cap():
+    planner = ExecutionTaskPlanner()
+    proposals = [make_proposal(i, 1.0, old=(i % 2, 3), new=(2, 3)) for i in range(10)]
+    plan = planner.plan(proposals)
+    tm = ExecutionTaskManager(plan, ConcurrencyLimits(inter_broker_per_broker=100,
+                                                      max_cluster_movements=4))
+    assert len(tm.next_inter_broker_tasks()) == 4
+
+
+def test_task_state_machine():
+    t = ExecutionTaskPlanner().plan([make_proposal(0, 1.0)]).inter_broker_tasks[0]
+    assert t.state == TaskState.PENDING
+    t.in_progress()
+    t.aborting()
+    t.aborted()
+    with pytest.raises(ValueError):
+        t.completed()
+
+
+# -- executor end-to-end -----------------------------------------------------
+
+def test_execute_proposals_end_to_end():
+    md = build_cluster()
+    mc, lm = monitored(md)
+    model, proposals = optimize_proposals(lm)
+    assert proposals
+    names = lm.naming()["partitions"]
+
+    admin = InMemoryClusterAdmin(mc, latency_polls=2)
+    ex = Executor(admin, mc, throttle_rate_bytes_per_sec=10_000_000)
+    result = ex.execute_proposals(proposals, names)
+    assert result.ok and result.completed > 0
+    assert ex.state() == ExecutorState.NO_TASK_IN_PROGRESS
+
+    # The cluster now matches every proposal's target replica set + leader.
+    cluster = mc.cluster()
+    by_tp = {p.tp: p for p in cluster.partitions}
+    for p in proposals:
+        got = by_tp[tuple(names[p.partition])]
+        assert set(got.replicas) == {r.broker for r in p.new_replicas}
+        assert got.leader == p.new_leader.broker
+    # Throttles were set for the batch and cleaned up afterwards.
+    assert admin.throttle_history and not admin.throttle_state
+
+
+def test_refuses_concurrent_execution_and_external_reassignment():
+    md = build_cluster()
+    mc, lm = monitored(md)
+    model, proposals = optimize_proposals(lm)
+    names = lm.naming()["partitions"]
+    admin = InMemoryClusterAdmin(mc, latency_polls=50)
+    # An external tool's reassignment is in flight: refuse.
+    p0 = mc.cluster().partitions[0]
+    other = [b.broker_id for b in mc.cluster().brokers if b.broker_id not in p0.replicas]
+    admin.alter_partition_reassignments([ReassignmentRequest(
+        tp=p0.tp, new_replicas=(other[0],) + tuple(p0.replicas[1:]))])
+    ex = Executor(admin, mc)
+    with pytest.raises(OngoingExecutionError):
+        ex.execute_proposals(proposals, names)
+    # Force-stop adopts/cancels, then execution is possible.
+    ex.stop_execution(force=True)
+    result = ex.execute_proposals(proposals, names)
+    assert result.completed > 0
+
+
+def test_sampling_paused_during_execution():
+    md = build_cluster()
+    mc, lm = monitored(md)
+    model, proposals = optimize_proposals(lm)
+    names = lm.naming()["partitions"]
+    admin = InMemoryClusterAdmin(mc)
+    events = []
+    ex = Executor(admin, mc,
+                  on_sampling_pause=lambda r: events.append(("pause", r)),
+                  on_sampling_resume=lambda: events.append(("resume",)))
+    ex.execute_proposals(proposals, names)
+    assert events[0][0] == "pause" and events[-1][0] == "resume"
+
+
+def test_dead_destination_marks_task_dead():
+    md = build_cluster()
+    mc, lm = monitored(md)
+    model, proposals = optimize_proposals(lm)
+    names = lm.naming()["partitions"]
+    # Kill a destination broker before execution.
+    dest = next((p.replicas_to_add[0] for p in proposals if p.replicas_to_add), None)
+    assert dest is not None, "optimizer produced no replica additions"
+    cluster = mc.cluster()
+    mc.refresh(dataclasses.replace(cluster, brokers=tuple(
+        dataclasses.replace(b, is_alive=(b.broker_id != dest))
+        for b in cluster.brokers)))
+    admin = InMemoryClusterAdmin(mc, latency_polls=3)
+    ex = Executor(admin, mc)
+    result = ex.execute_proposals(proposals, names, max_polls=200)
+    assert result.dead >= 1
+
+
+def test_executor_reservation_handshake():
+    md = build_cluster()
+    mc, _ = monitored(md)
+    ex = Executor(InMemoryClusterAdmin(mc), mc)
+    ex.set_generating_proposals_for_execution()
+    assert ex.state() == ExecutorState.GENERATING_PROPOSALS_FOR_EXECUTION
+    with pytest.raises(OngoingExecutionError):
+        ex.set_generating_proposals_for_execution()
+    ex.failed_generating_proposals_for_execution()
+    assert ex.state() == ExecutorState.NO_TASK_IN_PROGRESS
+
+
+def test_recently_removed_broker_retention():
+    md = build_cluster()
+    mc, _ = monitored(md)
+    ex = Executor(InMemoryClusterAdmin(mc), mc, removed_broker_retention_ms=1000)
+    ex.add_recently_removed_brokers([3], now_ms=0)
+    assert ex.recently_removed_brokers(now_ms=500) == {3}
+    assert ex.recently_removed_brokers(now_ms=2000) == set()
+    ex.add_recently_demoted_brokers([1], now_ms=0)
+    assert ex.recently_demoted_brokers(now_ms=100) == {1}
