@@ -55,6 +55,8 @@ class Candidates:
     d_potential_nw_out: Array  # f32[K] potential NW_OUT moved src→dest
     d_leader_bytes_in_src: Array  # f32[K] leader bytes-in removed from src
     d_leader_bytes_in_dest: Array  # f32[K] leader bytes-in added to dest
+    src_disk: Array  # i32[K] disk the replica currently occupies (-1 non-JBOD)
+    dest_disk: Array  # i32[K] landing disk (intra moves: target disk)
 
     @property
     def k(self) -> int:
@@ -66,9 +68,13 @@ class Candidates:
     def is_leadership(self) -> Array:
         return self.action_type == ActionType.LEADERSHIP_MOVEMENT
 
+    def is_intra_move(self) -> Array:
+        return self.action_type == ActionType.INTRA_BROKER_REPLICA_MOVEMENT
+
 
 def make_candidates(model: TensorClusterModel, replica_ids: Array, dest_brokers: Array,
-                    action_type: Array, dest_replica: Array, valid: Array) -> Candidates:
+                    action_type: Array, dest_replica: Array, valid: Array,
+                    dest_disks: Array = None) -> Candidates:
     """Assemble the delta fields for a K-batch of raw (replica, dest) picks.
 
     For replica movement: src loses the replica's current load, dest gains it
@@ -76,31 +82,45 @@ def make_candidates(model: TensorClusterModel, replica_ids: Array, dest_brokers:
     For leadership movement: src loses (leader - follower) load of `replica`,
     the dest replica's broker gains (leader - follower) of `dest_replica`
     (Rack.makeLeader/makeFollower delta semantics, ClusterModel.java:406-431).
+    Intra-broker movement (``dest_disks``) relocates the replica across its
+    broker's disks: broker-axis deltas are zero; the disk goals read the
+    replica's DISK contribution against src_disk/dest_disk.
     """
     is_lead = action_type == ActionType.LEADERSHIP_MOVEMENT
+    is_intra = action_type == ActionType.INTRA_BROKER_REPLICA_MOVEMENT
     r = replica_ids
     r2 = jnp.where(dest_replica >= 0, dest_replica, 0)
 
     src = model.replica_broker[r]
-    dest = jnp.where(is_lead, model.replica_broker[r2], dest_brokers)
+    dest = jnp.where(is_lead, model.replica_broker[r2],
+                     jnp.where(is_intra, src, dest_brokers))
 
     cur_load = jnp.where(model.replica_is_leader[r][:, None],
                          model.replica_load_leader[r], model.replica_load_follower[r])
     lead_delta_src = model.replica_load_follower[r] - model.replica_load_leader[r]
     lead_delta_dest = model.replica_load_leader[r2] - model.replica_load_follower[r2]
 
-    delta_src = jnp.where(is_lead[:, None], lead_delta_src, -cur_load)
-    delta_dest = jnp.where(is_lead[:, None], lead_delta_dest, cur_load)
+    zero = jnp.zeros_like(cur_load)
+    delta_src = jnp.where(is_lead[:, None], lead_delta_src,
+                          jnp.where(is_intra[:, None], zero, -cur_load))
+    delta_dest = jnp.where(is_lead[:, None], lead_delta_dest,
+                           jnp.where(is_intra[:, None], zero, cur_load))
 
     is_leader_replica = model.replica_is_leader[r]
-    d_replica_count = jnp.where(is_lead, 0, 1).astype(jnp.int32)
-    d_leader_count = jnp.where(is_lead | is_leader_replica, 1, 0).astype(jnp.int32)
-    d_potential = jnp.where(is_lead, 0.0, model.replica_load_leader[r, Resource.NW_OUT])
+    is_move = ~is_lead & ~is_intra
+    d_replica_count = jnp.where(is_move, 1, 0).astype(jnp.int32)
+    d_leader_count = jnp.where(is_lead | (is_move & is_leader_replica), 1, 0).astype(jnp.int32)
+    d_potential = jnp.where(is_move, model.replica_load_leader[r, Resource.NW_OUT], 0.0)
     leader_nw_in_r = model.replica_load_leader[r, Resource.NW_IN]
     leader_nw_in_r2 = model.replica_load_leader[r2, Resource.NW_IN]
-    d_lbi_src = jnp.where(is_lead | is_leader_replica, leader_nw_in_r, 0.0)
+    d_lbi_src = jnp.where(is_lead | (is_move & is_leader_replica), leader_nw_in_r, 0.0)
     d_lbi_dest = jnp.where(is_lead, leader_nw_in_r2,
-                           jnp.where(is_leader_replica, leader_nw_in_r, 0.0))
+                           jnp.where(is_move & is_leader_replica, leader_nw_in_r, 0.0))
+
+    src_disk = model.replica_disk[r]
+    if dest_disks is None:
+        dest_disks = model.broker_first_disk[jnp.where(dest >= 0, dest, 0)]
+    dest_disk = jnp.where(is_lead, src_disk, dest_disks.astype(jnp.int32))
 
     return Candidates(
         action_type=action_type.astype(jnp.int32),
@@ -117,13 +137,17 @@ def make_candidates(model: TensorClusterModel, replica_ids: Array, dest_brokers:
         d_potential_nw_out=d_potential,
         d_leader_bytes_in_src=d_lbi_src,
         d_leader_bytes_in_dest=d_lbi_dest,
+        src_disk=src_disk,
+        dest_disk=dest_disk,
     )
 
 
 def apply_candidates(model: TensorClusterModel, cand: Candidates, apply_mask: Array) -> TensorClusterModel:
-    """Apply the masked subset of candidates (moves then leaderships)."""
+    """Apply the masked subset of candidates (moves, disk moves, leaderships)."""
     move_mask = apply_mask & cand.is_move()
     model = model.relocate_replicas(cand.replica, cand.dest, move_mask)
+    intra_mask = apply_mask & cand.is_intra_move()
+    model = model.relocate_replicas_to_disk(cand.replica, cand.dest_disk, intra_mask)
     lead_mask = apply_mask & cand.is_leadership()
     safe_dest = jnp.where(cand.dest_replica >= 0, cand.dest_replica, cand.replica)
     model = model.relocate_leadership(cand.replica, safe_dest, lead_mask)

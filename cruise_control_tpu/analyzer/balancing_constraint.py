@@ -38,6 +38,10 @@ class BalancingConstraint:
     overprovisioned_min_brokers: int = 3
     overprovisioned_min_extra_racks: int = 2
     fast_mode_per_broker_move_timeout_ms: int = 500
+    # MinTopicLeadersPerBrokerGoal (config-static designated-topic ids +
+    # required leaders per broker; reference: topics.with.min.leaders.per.broker).
+    min_topic_leaders_per_broker: int = 1
+    min_leader_topic_ids: Tuple[int, ...] = ()
 
     @classmethod
     def from_config(cls, cfg: Config) -> "BalancingConstraint":

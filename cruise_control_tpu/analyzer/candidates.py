@@ -142,5 +142,36 @@ def leadership_candidates(spec: GoalSpec, model: TensorClusterModel, arrays: Bro
                            dest_replica, valid)
 
 
+def intra_disk_candidates(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
+                          constraint: BalancingConstraint, options: OptimizationOptions,
+                          num_sources: int) -> Candidates:
+    """K = S·max_disks_per_broker intra-broker disk-move candidates: each
+    top-ranked replica paired with every disk of its own broker
+    (IntraBrokerDiskUsageDistributionGoal's balanceBetweenDisks,
+    goals/IntraBrokerDiskUsageDistributionGoal.java:47)."""
+    relevance = kernels.source_replica_relevance(spec, model, arrays, constraint)
+    rel_vals, src_replicas = jax.lax.top_k(relevance, num_sources)  # [S]
+
+    broker = model.replica_broker[src_replicas]
+    disks = model.broker_disks[broker]                       # [S, max_dpb]
+    max_dpb = disks.shape[1]
+
+    replica = jnp.repeat(src_replicas, max_dpb)              # [K]
+    dest_disk = disks.reshape(-1)                            # [K]
+    src_ok = jnp.repeat(rel_vals > _NEG, max_dpb)
+
+    safe_disk = jnp.where(dest_disk >= 0, dest_disk, 0)
+    dest_alive = (dest_disk >= 0) & (model.disk_capacity[safe_disk] > 0.0) & \
+        model.disk_valid[safe_disk]
+    not_self = dest_disk != model.replica_disk[replica]
+
+    k = replica.shape[0]
+    action_type = jnp.full((k,), ActionType.INTRA_BROKER_REPLICA_MOVEMENT, jnp.int32)
+    dest_replica = jnp.full((k,), -1, jnp.int32)
+    valid = src_ok & dest_alive & not_self & model.replica_valid[replica]
+    return make_candidates(model, replica, model.replica_broker[replica], action_type,
+                           dest_replica, valid, dest_disks=dest_disk)
+
+
 def concat_candidates(a: Candidates, b: Candidates) -> Candidates:
     return jax.tree.map(lambda x, y: jnp.concatenate([x, y], axis=0), a, b)

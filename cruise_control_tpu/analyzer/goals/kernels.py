@@ -74,7 +74,89 @@ def broker_metric(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArray
         lower_t, upper_t = _topic_limits(model, arrays, constraint)
         excess = jnp.maximum(tbc - upper_t[:, None], 0.0) + jnp.maximum(lower_t[:, None] - tbc, 0.0)
         return excess.sum(axis=0)
+    if kind == "preferred_leader":
+        # Count of wrongly-led partitions whose current leader sits on the
+        # broker (PreferredLeaderElectionGoal.java:36).
+        wrong = _wrong_leader_mask(model)
+        from cruise_control_tpu.ops.segment import masked_segment_count
+        return masked_segment_count(model.replica_broker, model.num_brokers,
+                                    wrong).astype(jnp.float32)
+    if kind == "min_topic_leaders":
+        return _min_topic_leader_shortfall(model, arrays, constraint)
+    if kind in ("intra_disk_capacity", "intra_disk_distribution"):
+        # Per-broker: total excess over its disks' bands.  Everything still
+        # sitting on a dead disk (capacity < 0) is excess — the hard goal
+        # must NOT report satisfied while replicas are stranded there.
+        disk_load = model.disk_load()
+        lo_d, up_d = _disk_limits(spec, model, constraint)
+        excess = jnp.maximum(disk_load - up_d, 0.0) + jnp.maximum(lo_d - disk_load, 0.0)
+        dead = model.disk_capacity < 0.0
+        excess = jnp.where(dead, disk_load, excess)
+        excess = jnp.where(model.disk_valid, excess, 0.0)
+        from cruise_control_tpu.ops.segment import masked_segment_sum
+        return masked_segment_sum(excess, model.disk_broker, model.num_brokers,
+                                  model.disk_valid)
     raise NotImplementedError(f"goal kind {kind}")
+
+
+def _wrong_leader_mask(model: TensorClusterModel) -> Array:
+    """bool[R] — replica currently leads a partition whose preferred replica
+    is a different, online replica."""
+    preferred = model.preferred_leader_replica()[model.replica_partition]
+    r_idx = jnp.arange(model.num_replicas_padded, dtype=jnp.int32)
+    pref_ok = model.replica_valid[jnp.maximum(preferred, 0)] & \
+        ~model.replica_offline_now()[jnp.maximum(preferred, 0)] & (preferred >= 0)
+    return (model.replica_is_leader & model.replica_valid
+            & (preferred != r_idx) & pref_ok)
+
+
+def _designated_topic_mask(model: TensorClusterModel,
+                           constraint: BalancingConstraint) -> Array:
+    """bool[T] — topics designated for min-leader enforcement.  The set is
+    config-static in the reference (topics.with.min.leaders.per.broker), so
+    it lives on the frozen constraint as topic ids."""
+    mask = jnp.zeros((model.num_topics,), bool)
+    ids = [t for t in constraint.min_leader_topic_ids if t < model.num_topics]
+    if ids:
+        mask = mask.at[jnp.asarray(ids, jnp.int32)].set(True)
+    return mask
+
+
+def _min_topic_leader_shortfall(model: TensorClusterModel, arrays: BrokerArrays,
+                                constraint: BalancingConstraint) -> Array:
+    """f32[B] — sum over designated topics of max(0, min - leaders(t, b))
+    for alive brokers (MinTopicLeadersPerBrokerGoal.java:50)."""
+    tlc = model.topic_leader_counts().astype(jnp.float32)  # [T, B]
+    need = float(constraint.min_topic_leaders_per_broker)
+    designated = _designated_topic_mask(model, constraint)[:, None]  # [T, 1]
+    shortfall = jnp.where(designated, jnp.maximum(need - tlc, 0.0), 0.0).sum(axis=0)
+    return jnp.where(arrays.alive, shortfall, 0.0)
+
+
+def _disk_limits(spec: GoalSpec, model: TensorClusterModel,
+                 constraint: BalancingConstraint):
+    """(lower f32[D], upper f32[D]) bands on the disk axis.
+
+    ``intra_disk_capacity`` (IntraBrokerDiskCapacityGoal.java:42): usage ≤
+    capacity · threshold, no lower bound.  ``intra_disk_distribution``
+    (IntraBrokerDiskUsageDistributionGoal.java:47): each disk within ± the
+    DISK balance threshold of its broker's mean utilization percentage.
+    """
+    cap = jnp.maximum(model.disk_capacity, 1e-9)
+    if spec.kind == "intra_disk_capacity":
+        upper = cap * constraint.capacity_threshold[Resource.DISK]
+        return jnp.zeros_like(upper), upper
+    disk_load = model.disk_load()
+    from cruise_control_tpu.ops.segment import masked_segment_sum
+    ok = model.disk_valid & (model.disk_capacity > 0)
+    broker_load_d = masked_segment_sum(disk_load, model.disk_broker,
+                                       model.num_brokers, ok)
+    broker_cap_d = jnp.maximum(masked_segment_sum(
+        jnp.where(ok, model.disk_capacity, 0.0), model.disk_broker,
+        model.num_brokers, ok), 1e-9)
+    avg_pct = (broker_load_d / broker_cap_d)[model.disk_broker]
+    bp = constraint.balance_percentage(Resource.DISK)
+    return avg_pct * (2.0 - bp) * cap, avg_pct * bp * cap
 
 
 def limits(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
@@ -119,7 +201,9 @@ def limits(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
         avg = jnp.where(arrays.alive, arrays.leader_bytes_in, 0.0).sum() / arrays.num_alive
         # Cap-only goal: LeaderBytesInDistributionGoal balances the top end.
         return zero, jnp.broadcast_to(avg * bp, (B,))
-    if kind in ("rack", "rack_distribution", "topic_replica_distribution"):
+    if kind in ("rack", "rack_distribution", "topic_replica_distribution",
+                "preferred_leader", "min_topic_leaders",
+                "intra_disk_capacity", "intra_disk_distribution"):
         # Metric is a violation count/excess; the band is exactly zero.
         return zero, zero
     raise NotImplementedError(f"goal kind {kind}")
@@ -149,6 +233,8 @@ def _metric_epsilon(spec: GoalSpec) -> float:
         return Resource(spec.resource).epsilon * 1e-3
     if spec.kind in ("potential_nw_out", "leader_bytes_in"):
         return Resource.NW_OUT.epsilon * 1e-3
+    if spec.kind in ("intra_disk_capacity", "intra_disk_distribution"):
+        return Resource.DISK.epsilon * 1e-3
     return 1e-6  # count-based metrics are integral
 
 
@@ -245,6 +331,15 @@ def self_feasible(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArray
     (selfSatisfied + per-goal move eligibility)."""
     kind = spec.kind
     unhealthy = _src_unhealthy(model, cand, arrays)
+    if kind == "preferred_leader":
+        # Only leadership transfers to the partition's preferred replica.
+        preferred = model.preferred_leader_replica()[cand.partition]
+        wrong = _wrong_leader_mask(model)[cand.replica]
+        return cand.is_leadership() & wrong & (cand.dest_replica == preferred)
+    if kind == "min_topic_leaders":
+        return _min_leader_feasible(model, arrays, cand, constraint, unhealthy)
+    if kind in ("intra_disk_capacity", "intra_disk_distribution"):
+        return _intra_disk_feasible(spec, model, cand, constraint)
     if kind in ("rack", "rack_distribution"):
         conflict = _replica_rack_conflict(spec, model)[cand.replica]
         ok_dest = _move_rack_ok(spec, model, cand)
@@ -274,12 +369,74 @@ def self_feasible(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArray
     return helps & dest_ok & src_ok & moves_something
 
 
+def _min_leader_feasible(model: TensorClusterModel, arrays: BrokerArrays,
+                         cand: Candidates, constraint: BalancingConstraint,
+                         unhealthy: Array) -> Array:
+    """Leadership transfer or leader-replica move of a designated topic into
+    a broker short of leaders, without starving the source."""
+    designated = _designated_topic_mask(model, constraint)
+    t = model.replica_topic[cand.replica]
+    tlc = model.topic_leader_counts()
+    need = constraint.min_topic_leaders_per_broker
+    gains_leader = cand.is_leadership() | (cand.is_move() & model.replica_is_leader[cand.replica])
+    dest_short = tlc[t, cand.dest] < need
+    src_ok = (tlc[t, cand.src] - 1 >= need) | unhealthy
+    return designated[t] & gains_leader & dest_short & src_ok
+
+
+def _intra_disk_feasible(spec: GoalSpec, model: TensorClusterModel,
+                         cand: Candidates, constraint: BalancingConstraint) -> Array:
+    """Intra-broker disk move out of an over-band (or dead) disk onto a disk
+    of the same broker that stays within band after receiving the replica."""
+    disk_load = model.disk_load()
+    lo_d, up_d = _disk_limits(spec, model, constraint)
+    s = jnp.maximum(cand.src_disk, 0)
+    d = jnp.maximum(cand.dest_disk, 0)
+    contrib = model.replica_load()[cand.replica, Resource.DISK]
+    src_dead = model.disk_capacity[s] < 0.0
+    src_over = disk_load[s] > up_d[s]
+    dest_under = disk_load[d] < lo_d[d]
+    helps = src_over | dest_under | src_dead
+    dest_ok = (disk_load[d] + contrib <= up_d[d]) & (model.disk_capacity[d] > 0.0)
+    src_stays = (disk_load[s] - contrib >= lo_d[s]) | src_dead | src_over
+    same_broker = model.disk_broker[d] == cand.src
+    valid_disks = (cand.src_disk >= 0) & (cand.dest_disk >= 0) & \
+        (cand.src_disk != cand.dest_disk)
+    return (cand.is_intra_move() & valid_disks & same_broker & helps
+            & dest_ok & src_stays)
+
+
 def accepts(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
             cand: Candidates, constraint: BalancingConstraint) -> Array:
     """bool[K] — this (already optimized) goal does not veto the candidate
     (actionAcceptance; reference evaluates these for every previously
     optimized goal before applying an action, AnalyzerUtils.java:117)."""
     kind = spec.kind
+    if kind == "preferred_leader":
+        # Reference parity: PreferredLeaderElectionGoal.actionAcceptance
+        # returns ACCEPT unconditionally (PreferredLeaderElectionGoal.java) —
+        # it is a one-shot election pass, not a standing constraint, so later
+        # leadership goals stay free to move leaders.
+        return jnp.ones(cand.k, bool)
+    if kind == "min_topic_leaders":
+        # Veto actions that starve a designated topic's source broker.
+        designated = _designated_topic_mask(model, constraint)
+        t = model.replica_topic[cand.replica]
+        loses_leader = cand.is_leadership() | (cand.is_move() & model.replica_is_leader[cand.replica])
+        tlc = model.topic_leader_counts()
+        starves = designated[t] & loses_leader & \
+            (tlc[t, cand.src] - 1 < constraint.min_topic_leaders_per_broker) & \
+            arrays.alive[cand.src]
+        return ~starves
+    if kind in ("intra_disk_capacity", "intra_disk_distribution"):
+        # Veto moves landing on a disk that would overflow its band.
+        disk_load = model.disk_load()
+        _, up_d = _disk_limits(spec, model, constraint)
+        d = jnp.maximum(cand.dest_disk, 0)
+        contrib = model.replica_load()[cand.replica, Resource.DISK]
+        changes_disk = (cand.is_move() | cand.is_intra_move()) & (cand.dest_disk >= 0)
+        over = disk_load[d] + contrib > up_d[d]
+        return ~(changes_disk & over)
     if kind in ("rack", "rack_distribution"):
         return jnp.where(cand.is_move(), _move_rack_ok(spec, model, cand), True)
     if kind == "topic_replica_distribution":
@@ -311,6 +468,35 @@ def score(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
     kind = spec.kind
     unhealthy = _src_unhealthy(model, cand, arrays)
     bonus = jnp.where(unhealthy & cand.is_move(), _OFFLINE_BONUS, 0.0)
+    if kind == "preferred_leader":
+        preferred = model.preferred_leader_replica()[cand.partition]
+        fixes = cand.is_leadership() & (cand.dest_replica == preferred) & \
+            _wrong_leader_mask(model)[cand.replica]
+        return jnp.where(fixes, 1.0, 0.0)
+    if kind == "min_topic_leaders":
+        tlc = model.topic_leader_counts().astype(jnp.float32)
+        t = model.replica_topic[cand.replica]
+        need = float(constraint.min_topic_leaders_per_broker)
+        designated = _designated_topic_mask(model, constraint)[t]
+        gain = jnp.minimum(jnp.maximum(need - tlc[t, cand.dest], 0.0), 1.0)
+        loss = jnp.maximum(need - (tlc[t, cand.src] - 1.0), 0.0) \
+            - jnp.maximum(need - tlc[t, cand.src], 0.0)
+        return jnp.where(designated, gain - jnp.minimum(loss, 1.0), 0.0) + bonus
+    if kind in ("intra_disk_capacity", "intra_disk_distribution"):
+        disk_load = model.disk_load()
+        lo_d, up_d = _disk_limits(spec, model, constraint)
+        s = jnp.maximum(cand.src_disk, 0)
+        d = jnp.maximum(cand.dest_disk, 0)
+        contrib = model.replica_load()[cand.replica, Resource.DISK]
+
+        def dev(load, disk):
+            return jnp.maximum(load - up_d[disk], 0.0) + \
+                jnp.maximum(lo_d[disk] - load, 0.0)
+
+        before = dev(disk_load[s], s) + dev(disk_load[d], d)
+        after = dev(disk_load[s] - contrib, s) + dev(disk_load[d] + contrib, d)
+        dead_bonus = jnp.where(model.disk_capacity[s] < 0.0, _OFFLINE_BONUS, 0.0)
+        return jnp.where(cand.is_intra_move(), before - after + dead_bonus, 0.0)
     if kind in ("rack", "rack_distribution"):
         sib, _, sib_rack, sib_valid = _sibling_info(model, cand.replica)
         own_rack = model.broker_rack[cand.src]
@@ -378,6 +564,10 @@ def source_pressure(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArr
 def dest_room(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
               constraint: BalancingConstraint) -> Array:
     """f32[B] — headroom under the goal's upper limit (candidate dests)."""
+    if spec.kind == "min_topic_leaders":
+        # Destinations are exactly the brokers short of designated leaders.
+        shortfall = _min_topic_leader_shortfall(model, arrays, constraint)
+        return jnp.where(arrays.alive, shortfall, -_BIG)
     metric = broker_metric(spec, model, arrays, constraint)
     lower, upper = limits(spec, model, arrays, constraint)
     room = jnp.minimum(upper, _BIG) - metric
@@ -392,8 +582,41 @@ def source_replica_relevance(spec: GoalSpec, model: TensorClusterModel, arrays: 
     Combines source-broker pressure with a per-replica tiebreak (bigger
     replicas first, mirroring the reference's load-sorted candidate replicas
     via SortedReplicas, model/SortedReplicas.java:47)."""
-    pressure = source_pressure(spec, model, arrays, constraint)[model.replica_broker]
     kind = spec.kind
+    if kind == "preferred_leader":
+        wrong = _wrong_leader_mask(model)
+        return jnp.where(wrong & model.replica_valid, 1.0, -_BIG)
+    if kind == "min_topic_leaders":
+        # Donor leaders of designated topics on brokers above the minimum
+        # (plus any leader when a shortfall exists and the source is dead).
+        designated = _designated_topic_mask(model, constraint)[model.replica_topic]
+        tlc = model.topic_leader_counts()
+        cnt = tlc[model.replica_topic, model.replica_broker]
+        need = constraint.min_topic_leaders_per_broker
+        donor = model.replica_is_leader & designated & (cnt > need)
+        dead_src = ~arrays.alive[model.replica_broker]
+        base = jnp.where(donor | (designated & model.replica_is_leader & dead_src),
+                         1.0, -_BIG)
+        return jnp.where(model.replica_valid, base, -_BIG)
+    if kind in ("intra_disk_capacity", "intra_disk_distribution"):
+        disk_load = model.disk_load()
+        lo_d, up_d = _disk_limits(spec, model, constraint)
+        s = jnp.maximum(model.replica_disk, 0)
+        on_disk = model.replica_disk >= 0
+        over = disk_load[s] > up_d[s]
+        dead = model.disk_capacity[s] < 0.0
+        # Donors also come from in-band disks when a sibling disk is under.
+        broker_has_under = jnp.zeros((model.num_brokers,), bool).at[
+            jnp.where(model.disk_valid, model.disk_broker, 0)].max(
+            model.disk_valid & (disk_load < lo_d))
+        donor = broker_has_under[model.replica_broker] & \
+            (disk_load[s] > (lo_d[s] + up_d[s]) * 0.5)
+        size = model.replica_load()[:, Resource.DISK]
+        scale = jnp.maximum(size.max(), 1e-9)
+        base = jnp.where(dead, _BIG,
+                         jnp.where(over | donor, 1.0 + 1e-3 * size / scale, -_BIG))
+        return jnp.where(model.replica_valid & on_disk, base, -_BIG)
+    pressure = source_pressure(spec, model, arrays, constraint)[model.replica_broker]
     if kind in ("rack", "rack_distribution"):
         conflict = _replica_rack_conflict(spec, model)
         base = jnp.where(conflict, 1.0, -_BIG)

@@ -28,6 +28,10 @@ class GoalSpec:
     # Which action families the goal uses to improve itself.
     uses_moves: bool = True
     uses_leadership: bool = False
+    uses_intra_moves: bool = False
+    # kafka-assigner compatibility mode (kafkaassigner/*.java): same kernel
+    # families, flagged so mode-specific goal lists can be assembled.
+    kafka_assigner_mode: bool = False
 
 
 def _capacity(name: str, resource: Resource) -> GoalSpec:
@@ -70,10 +74,63 @@ GOAL_SPECS: Dict[str, GoalSpec] = {
     "LeaderBytesInDistributionGoal": GoalSpec("LeaderBytesInDistributionGoal",
                                               "leader_bytes_in", uses_moves=False,
                                               uses_leadership=True),
-    # PreferredLeaderElectionGoal, MinTopicLeadersPerBrokerGoal and the
-    # kafka-assigner modes are added together with their kernels; the registry
-    # only advertises goals whose kernel families exist.
+    # Make replica[0] the leader (goals/PreferredLeaderElectionGoal.java:36).
+    "PreferredLeaderElectionGoal": GoalSpec("PreferredLeaderElectionGoal",
+                                            "preferred_leader", uses_moves=False,
+                                            uses_leadership=True),
+    # ≥ configured leaders of designated topics per broker
+    # (goals/MinTopicLeadersPerBrokerGoal.java:50).
+    "MinTopicLeadersPerBrokerGoal": GoalSpec("MinTopicLeadersPerBrokerGoal",
+                                             "min_topic_leaders", is_hard=True,
+                                             uses_moves=True, uses_leadership=True),
+    # JBOD intra-broker disk goals (goals/IntraBrokerDiskCapacityGoal.java:42,
+    # IntraBrokerDiskUsageDistributionGoal.java:47) — rebalance-disk mode.
+    "IntraBrokerDiskCapacityGoal": GoalSpec("IntraBrokerDiskCapacityGoal",
+                                            "intra_disk_capacity", is_hard=True,
+                                            uses_moves=False, uses_intra_moves=True),
+    "IntraBrokerDiskUsageDistributionGoal": GoalSpec(
+        "IntraBrokerDiskUsageDistributionGoal", "intra_disk_distribution",
+        uses_moves=False, uses_intra_moves=True),
+    # kafka-assigner compatibility modes (kafkaassigner/
+    # KafkaAssignerEvenRackAwareGoal.java:42, round-robin rack-aware placement;
+    # KafkaAssignerDiskUsageDistributionGoal.java:48, swap-based disk
+    # balancing) — mapped onto the rack / disk-distribution kernel families.
+    "KafkaAssignerEvenRackAwareGoal": GoalSpec("KafkaAssignerEvenRackAwareGoal",
+                                               "rack", is_hard=True,
+                                               kafka_assigner_mode=True),
+    "KafkaAssignerDiskUsageDistributionGoal": GoalSpec(
+        "KafkaAssignerDiskUsageDistributionGoal", "resource_distribution",
+        resource=int(Resource.DISK), kafka_assigner_mode=True),
 }
+
+KAFKA_ASSIGNER_GOALS = [n for n, s in GOAL_SPECS.items() if s.kafka_assigner_mode]
+
+# Reference default priority order (config/cruisecontrol.properties:98-126).
+DEFAULT_GOAL_ORDER = [
+    "RackAwareGoal",
+    "MinTopicLeadersPerBrokerGoal",
+    "ReplicaCapacityGoal",
+    "DiskCapacityGoal",
+    "NetworkInboundCapacityGoal",
+    "NetworkOutboundCapacityGoal",
+    "CpuCapacityGoal",
+    "ReplicaDistributionGoal",
+    "PotentialNwOutGoal",
+    "DiskUsageDistributionGoal",
+    "NetworkInboundUsageDistributionGoal",
+    "NetworkOutboundUsageDistributionGoal",
+    "CpuUsageDistributionGoal",
+    "TopicReplicaDistributionGoal",
+    "LeaderReplicaDistributionGoal",
+    "LeaderBytesInDistributionGoal",
+]
+
+DEFAULT_HARD_GOALS = [n for n in DEFAULT_GOAL_ORDER if GOAL_SPECS[n].is_hard]
+
+INTRA_BROKER_GOAL_ORDER = [
+    "IntraBrokerDiskCapacityGoal",
+    "IntraBrokerDiskUsageDistributionGoal",
+]
 
 
 def goals_by_priority(names: Sequence[str]) -> List[GoalSpec]:

@@ -134,6 +134,9 @@ def _goal_step(model: TensorClusterModel, options: OptimizationOptions,
     if spec.uses_leadership:
         batches.append(cgen.leadership_candidates(spec, model, arrays, constraint,
                                                   options, num_sources))
+    if spec.uses_intra_moves:
+        batches.append(cgen.intra_disk_candidates(spec, model, arrays, constraint,
+                                                  options, num_sources))
     cand = batches[0]
     for extra in batches[1:]:
         cand = cgen.concat_candidates(cand, extra)
@@ -196,6 +199,7 @@ class OptimizerRun:
     stats_before: ClusterModelStats
     stats_after: ClusterModelStats
     num_candidates_scored: int
+    provision_response: object = None  # ProvisionResponse
 
     @property
     def violated_goals_before(self) -> List[str]:
@@ -273,6 +277,8 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
         k = ns * nd * (1 if spec.uses_moves else 0)
         if spec.uses_leadership:
             k += ns * model.max_rf
+        if spec.uses_intra_moves:
+            k += ns * model.broker_disks.shape[1]
         scored += steps * k
         results.append(GoalResult(name=spec.name, is_hard=spec.is_hard,
                                   satisfied_before=before, satisfied_after=after,
@@ -283,5 +289,13 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
                 f"hard goal {spec.name} not satisfied after optimization")
         prev = prev + (spec,)
 
+    from cruise_control_tpu.analyzer.provisioning import (ProvisionResponse,
+                                                          provision_verdict_for_goal)
+    provision = ProvisionResponse()
+    for spec, res in zip(specs, results):
+        provision.aggregate(provision_verdict_for_goal(spec, model, constraint,
+                                                       res.satisfied_after))
+
     return OptimizerRun(model=model, goal_results=results, stats_before=stats_before,
-                        stats_after=compute_stats(model), num_candidates_scored=scored)
+                        stats_after=compute_stats(model), num_candidates_scored=scored,
+                        provision_response=provision)

@@ -1,0 +1,139 @@
+"""Provisioning verdicts: is the cluster right-sized?
+
+Parity with ``ProvisionStatus``/``ProvisionRecommendation``/
+``ProvisionResponse`` (analyzer/ProvisionRecommendation.java and the
+per-goal provisionResponse plumbing, Goal.java:39): capacity goals that
+cannot be satisfied yield UNDER_PROVISIONED with a recommended broker
+count; distribution goals whose utilization sits below the low-utilization
+threshold yield OVER_PROVISIONED with an allowed-removal count
+(ResourceDistributionGoal.initGoalState :238-281).  Verdicts aggregate
+across goals with UNDER dominating OVER (ProvisionResponse.aggregate).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import math
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from cruise_control_tpu.analyzer.balancing_constraint import BalancingConstraint
+from cruise_control_tpu.analyzer.goals.specs import GoalSpec
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.model.tensor_model import TensorClusterModel
+
+
+class ProvisionStatus(enum.Enum):
+    """analyzer/ProvisionStatus."""
+
+    RIGHT_SIZED = "right_sized"
+    UNDER_PROVISIONED = "under_provisioned"
+    OVER_PROVISIONED = "over_provisioned"
+    UNDECIDED = "undecided"
+
+
+@dataclasses.dataclass(frozen=True)
+class ProvisionRecommendation:
+    """analyzer/ProvisionRecommendation.java (builder fields)."""
+
+    status: ProvisionStatus
+    num_brokers: int = -1          # brokers to add (UNDER) / removable (OVER)
+    resource: Optional[int] = None
+    reason: str = ""
+
+    def to_dict(self) -> Dict[str, object]:
+        out: Dict[str, object] = {"status": self.status.value, "reason": self.reason}
+        if self.num_brokers >= 0:
+            out["numBrokers"] = self.num_brokers
+        if self.resource is not None:
+            out["resource"] = Resource(self.resource).resource_name
+        return out
+
+
+@dataclasses.dataclass
+class ProvisionResponse:
+    """Aggregated verdict (ProvisionResponse.aggregate: UNDER > OVER >
+    RIGHT_SIZED > UNDECIDED)."""
+
+    status: ProvisionStatus = ProvisionStatus.UNDECIDED
+    recommendations: List[ProvisionRecommendation] = dataclasses.field(default_factory=list)
+
+    _RANK = {ProvisionStatus.UNDER_PROVISIONED: 3, ProvisionStatus.OVER_PROVISIONED: 2,
+             ProvisionStatus.RIGHT_SIZED: 1, ProvisionStatus.UNDECIDED: 0}
+
+    def aggregate(self, rec: ProvisionRecommendation) -> None:
+        if rec.status != ProvisionStatus.RIGHT_SIZED:
+            self.recommendations.append(rec)
+        if self._RANK[rec.status] > self._RANK[self.status]:
+            self.status = rec.status
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"status": self.status.value,
+                "recommendations": [r.to_dict() for r in self.recommendations]}
+
+
+def provision_verdict_for_goal(spec: GoalSpec, model: TensorClusterModel,
+                               constraint: BalancingConstraint,
+                               satisfied_after: bool) -> ProvisionRecommendation:
+    """Per-goal verdict after optimization."""
+    alive = np.asarray(model.alive_broker_mask())
+    num_alive = max(int(alive.sum()), 1)
+    load = np.asarray(model.broker_load())[alive]
+    cap = np.asarray(model.broker_capacity)[alive]
+
+    if spec.kind in ("capacity", "potential_nw_out"):
+        res = spec.resource if spec.resource >= 0 else int(Resource.NW_OUT)
+        threshold = constraint.capacity_threshold[res]
+        total_load = float(load[:, res].sum())
+        per_broker_cap = float(cap[:, res].mean()) * threshold
+        if not satisfied_after and per_broker_cap > 0:
+            needed = math.ceil(total_load / per_broker_cap) - num_alive
+            return ProvisionRecommendation(
+                ProvisionStatus.UNDER_PROVISIONED, num_brokers=max(needed, 1),
+                resource=res,
+                reason=f"{spec.name}: total {Resource(res).resource_name} load "
+                       f"{total_load:.1f} exceeds capacity at {num_alive} brokers")
+        return ProvisionRecommendation(ProvisionStatus.RIGHT_SIZED, resource=res)
+
+    if spec.kind == "resource_distribution":
+        res = spec.resource
+        low = constraint.low_utilization_threshold[res]
+        total_load = float(load[:, res].sum())
+        total_cap = max(float(cap[:, res].sum()), 1e-9)
+        avg_pct = total_load / total_cap
+        if low > 0 and avg_pct <= low:
+            # Cluster could shed brokers and stay under the low threshold
+            # (bounded by min-broker / rack constraints).
+            per_cap = total_cap / num_alive
+            min_needed = max(math.ceil(total_load / max(low * per_cap, 1e-9)),
+                             constraint.overprovisioned_min_brokers)
+            removable = max(num_alive - min_needed, 0)
+            if removable > 0:
+                return ProvisionRecommendation(
+                    ProvisionStatus.OVER_PROVISIONED, num_brokers=removable,
+                    resource=res,
+                    reason=f"{spec.name}: avg {Resource(res).resource_name} "
+                           f"utilization {avg_pct:.3f} below threshold {low}")
+        return ProvisionRecommendation(ProvisionStatus.RIGHT_SIZED, resource=res)
+
+    if spec.kind == "replica_capacity":
+        counts = np.asarray(model.broker_replica_counts())[alive]
+        if not satisfied_after:
+            total = int(counts.sum())
+            needed = math.ceil(total / constraint.max_replicas_per_broker) - num_alive
+            return ProvisionRecommendation(
+                ProvisionStatus.UNDER_PROVISIONED, num_brokers=max(needed, 1),
+                reason=f"{spec.name}: {total} replicas exceed "
+                       f"{constraint.max_replicas_per_broker}/broker at {num_alive} brokers")
+        return ProvisionRecommendation(ProvisionStatus.RIGHT_SIZED)
+
+    if spec.kind in ("rack", "rack_distribution") and not satisfied_after:
+        rf = int(np.asarray(model.partition_replication_factor()).max(initial=0))
+        if rf > model.num_racks:
+            return ProvisionRecommendation(
+                ProvisionStatus.UNDER_PROVISIONED, num_brokers=-1,
+                reason=f"{spec.name}: max replication factor {rf} exceeds "
+                       f"{model.num_racks} racks (add racks)")
+    return ProvisionRecommendation(ProvisionStatus.UNDECIDED)

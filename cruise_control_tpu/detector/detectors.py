@@ -1,0 +1,342 @@
+"""Anomaly detectors.
+
+Parity with the reference's detector suite (SURVEY.md §2.6):
+
+- ``GoalViolationDetector`` (GoalViolationDetector.java:55): re-checks the
+  detection goals on a fresh cluster model, splits violations into fixable
+  vs unfixable, skips when offline replicas exist (defers to the failure
+  detectors).
+- ``BrokerFailureDetector`` (BrokerFailureDetector.java:44): diffs the
+  expected broker set against live metadata; failure times persisted to a
+  JSON file so grace periods survive restarts (the reference persists them
+  in its own ZK path).
+- ``DiskFailureDetector`` (DiskFailureDetector.java:34): offline logdirs via
+  the admin's describe_logdirs.
+- ``MetricAnomalyDetector`` + ``PercentileMetricAnomalyFinder`` (core SPI,
+  cruise-control-core detector/metricanomaly/) and ``SlowBrokerFinder``
+  (SlowBrokerFinder.java:33-105): log-flush-time 999th percentile, raw and
+  normalized by bytes-in, compared against the broker's own history
+  percentile AND its peers; slowness-score escalation demotion → removal;
+  unfixable when too many brokers look slow at once.
+- ``TopicAnomalyDetector`` with RF and partition-size finders
+  (TopicReplicationFactorAnomalyFinder.java, PartitionSizeAnomalyFinder).
+- ``MaintenanceEventDetector`` + queue-backed reader with idempotence cache
+  (MaintenanceEventTopicReader.java:25, IdempotenceCache.java).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from cruise_control_tpu.analyzer.balancing_constraint import BalancingConstraint
+from cruise_control_tpu.analyzer.goals import kernels
+from cruise_control_tpu.analyzer.goals.specs import goals_by_priority
+from cruise_control_tpu.analyzer.state import BrokerArrays
+from cruise_control_tpu.detector.anomalies import (Anomaly, BrokerFailures, DiskFailures,
+                                                   GoalViolations, MaintenanceEvent,
+                                                   SlowBrokers,
+                                                   TopicPartitionSizeAnomaly,
+                                                   TopicReplicationFactorAnomaly)
+from cruise_control_tpu.monitor.load_monitor import (LoadMonitor,
+                                                     NotEnoughValidWindowsError)
+from cruise_control_tpu.monitor.metricdef import KAFKA_METRIC_DEF
+
+
+class GoalViolationDetector:
+    def __init__(self, load_monitor: LoadMonitor, detection_goals: Sequence[str],
+                 constraint: Optional[BalancingConstraint] = None):
+        self._lm = load_monitor
+        self._goals = list(detection_goals)
+        self._constraint = constraint or BalancingConstraint.default()
+        self.last_checked_generation: Optional[Tuple[int, int]] = None
+
+    def detect(self, now_ms: int) -> Optional[GoalViolations]:
+        try:
+            model = self._lm.cluster_model()
+        except NotEnoughValidWindowsError:
+            return None
+        if bool(np.asarray(model.replica_offline_now()).any()):
+            # Defer to broker/disk failure detectors (GoalViolationDetector
+            # skips when offline replicas exist, :160-237).
+            return None
+        gen = self._lm.model_generation().as_tuple()
+        self.last_checked_generation = gen
+        arrays = BrokerArrays.from_model(model)
+        fixable: List[str] = []
+        unfixable: List[str] = []
+        rf_max = int(np.asarray(model.partition_replication_factor()).max(initial=0))
+        for spec in goals_by_priority(self._goals):
+            if bool(kernels.goal_satisfied(spec, model, arrays, self._constraint)):
+                continue
+            if spec.kind in ("rack", "rack_distribution") and rf_max > model.num_racks:
+                unfixable.append(spec.name)
+            else:
+                fixable.append(spec.name)
+        if not fixable and not unfixable:
+            return None
+        return GoalViolations(detection_time_ms=now_ms, fixable_goals=fixable,
+                              unfixable_goals=unfixable)
+
+
+class BrokerFailureDetector:
+    def __init__(self, metadata_client, persist_path: Optional[str] = None):
+        self._md = metadata_client
+        self._path = persist_path
+        self._failure_times: Dict[int, int] = {}
+        self._known: Set[int] = set()
+        self._lock = threading.Lock()
+        if persist_path and os.path.exists(persist_path):
+            with open(persist_path) as f:
+                self._failure_times = {int(k): int(v) for k, v in json.load(f).items()}
+
+    def _persist(self) -> None:
+        if self._path:
+            os.makedirs(os.path.dirname(self._path) or ".", exist_ok=True)
+            with open(self._path, "w") as f:
+                json.dump(self._failure_times, f)
+
+    def detect(self, now_ms: int) -> Optional[BrokerFailures]:
+        cluster = self._md.cluster()
+        with self._lock:
+            alive = set(cluster.alive_broker_ids())
+            self._known |= {b.broker_id for b in cluster.brokers}
+            failed = self._known - alive
+            changed = False
+            for b in failed:
+                if b not in self._failure_times:
+                    self._failure_times[b] = now_ms
+                    changed = True
+            for b in list(self._failure_times):
+                if b in alive:
+                    del self._failure_times[b]
+                    changed = True
+            if changed:
+                self._persist()
+            if not self._failure_times:
+                return None
+            return BrokerFailures(detection_time_ms=now_ms,
+                                  failed_brokers=dict(self._failure_times))
+
+    def forget(self, brokers: Sequence[int]) -> None:
+        """Drop brokers that were healed/removed so they stop re-alerting."""
+        with self._lock:
+            for b in brokers:
+                self._failure_times.pop(b, None)
+                self._known.discard(b)
+            self._persist()
+
+
+class DiskFailureDetector:
+    def __init__(self, admin, metadata_client):
+        self._admin = admin
+        self._md = metadata_client
+
+    def detect(self, now_ms: int) -> Optional[DiskFailures]:
+        alive = set(self._md.cluster().alive_broker_ids())
+        failed: Dict[int, Tuple[str, ...]] = {}
+        for broker, dirs in self._admin.describe_logdirs().items():
+            if broker not in alive:
+                continue  # whole-broker failure is the broker detector's job
+            dead = tuple(ld for ld, ok in dirs.items() if not ok)
+            if dead:
+                failed[broker] = dead
+        if not failed:
+            return None
+        return DiskFailures(detection_time_ms=now_ms, failed_disks=failed)
+
+
+class PercentileMetricAnomalyFinder:
+    """core detector/metricanomaly/PercentileMetricAnomalyFinder.java: flag
+    brokers whose latest value exceeds the upper percentile of their own
+    history by a margin."""
+
+    def __init__(self, metric_name: str, upper_percentile: float = 95.0,
+                 margin: float = 1.0):
+        self.metric = metric_name
+        self._pct = upper_percentile
+        self._margin = margin
+
+    def anomalies(self, broker_agg) -> Dict[int, float]:
+        res = broker_agg.aggregate()
+        mid = KAFKA_METRIC_DEF.metric_info(self.metric).metric_id
+        out: Dict[int, float] = {}
+        vals = res.values[:, :, mid]  # [E, W]
+        if vals.shape[1] < 3:
+            return out
+        for row, broker in enumerate(res.entities):
+            history, latest = vals[row, :-1], vals[row, -1]
+            if not res.window_valid[row, -1] or not res.window_valid[row, :-1].any():
+                continue
+            hist = history[res.window_valid[row, :-1]]
+            threshold = np.percentile(hist, self._pct) * self._margin
+            if latest > threshold and latest > 0:
+                out[broker] = float(latest / max(threshold, 1e-9))
+        return out
+
+
+class SlowBrokerFinder:
+    """SlowBrokerFinder.java:109 semantics, over the broker aggregator.
+
+    A broker is *suspect* when its log-flush-time 999th (raw AND normalized
+    by bytes-in) exceeds both (a) its own history's upper percentile and
+    (b) the peer-cluster median by a factor.  Suspects accumulate a
+    slowness score across detections; score ≥ demote threshold → demote,
+    ≥ removal threshold → remove.  If more than half the cluster looks
+    slow, the anomaly is unfixable (self-healing would destroy capacity) —
+    reported with no brokers to fix.
+    """
+
+    METRIC = "BROKER_LOG_FLUSH_TIME_MS_999TH"
+    BYTES_METRIC = "LEADER_BYTES_IN"
+
+    def __init__(self, history_percentile: float = 90.0, history_margin: float = 3.0,
+                 peer_margin: float = 3.0, demote_score: int = 5,
+                 removal_score: int = 10):
+        self._pct = history_percentile
+        self._hist_margin = history_margin
+        self._peer_margin = peer_margin
+        self._demote = demote_score
+        self._removal = removal_score
+        self._scores: Dict[int, int] = {}
+
+    def _suspects(self, res, mid: int, bytes_mid: int) -> Set[int]:
+        vals = res.values[:, :, mid]
+        bts = np.maximum(res.values[:, :, bytes_mid], 1e-9)
+        norm = vals / bts
+        suspects: Set[int] = set()
+        latest_all = []
+        for row in range(vals.shape[0]):
+            if res.window_valid[row, -1]:
+                latest_all.append(vals[row, -1])
+        peer_median = np.median(latest_all) if latest_all else 0.0
+        for row, broker in enumerate(res.entities):
+            if not res.window_valid[row, -1] or vals.shape[1] < 3:
+                continue
+            hist_ok = res.window_valid[row, :-1]
+            if not hist_ok.any():
+                continue
+            raw_hist = np.percentile(vals[row, :-1][hist_ok], self._pct)
+            norm_hist = np.percentile(norm[row, :-1][hist_ok], self._pct)
+            raw_now, norm_now = vals[row, -1], norm[row, -1]
+            own_slow = raw_now > raw_hist * self._hist_margin and \
+                norm_now > norm_hist * self._hist_margin
+            peer_slow = peer_median > 0 and raw_now > peer_median * self._peer_margin
+            if own_slow and peer_slow:
+                suspects.add(broker)
+        return suspects
+
+    def detect(self, broker_agg, now_ms: int) -> Optional[SlowBrokers]:
+        res = broker_agg.aggregate()
+        if res.values.shape[0] == 0 or res.values.shape[1] < 3:
+            return None
+        mid = KAFKA_METRIC_DEF.metric_info(self.METRIC).metric_id
+        bmid = KAFKA_METRIC_DEF.metric_info(self.BYTES_METRIC).metric_id
+        suspects = self._suspects(res, mid, bmid)
+        for b in list(self._scores):
+            if b not in suspects:
+                self._scores[b] = max(self._scores[b] - 1, 0)
+                if self._scores[b] == 0:
+                    del self._scores[b]
+        for b in suspects:
+            self._scores[b] = self._scores.get(b, 0) + 1
+
+        to_remove = {b: float(s) for b, s in self._scores.items() if s >= self._removal}
+        to_demote = {b: float(s) for b, s in self._scores.items()
+                     if self._demote <= s < self._removal}
+        num_brokers = res.values.shape[0]
+        if len(suspects) > num_brokers // 2:
+            # Too many suspects ⇒ systemic (not per-broker) slowness; fixing
+            # by demotion/removal would destroy capacity — report nothing
+            # (the reference marks such anomalies unfixable).
+            return None
+        if to_remove:
+            return SlowBrokers(detection_time_ms=now_ms, slow_brokers=to_remove,
+                               fix_by_removal=True)
+        if to_demote:
+            return SlowBrokers(detection_time_ms=now_ms, slow_brokers=to_demote,
+                               fix_by_removal=False)
+        return None
+
+
+class TopicAnomalyDetector:
+    def __init__(self, metadata_client, desired_rf: int = 3,
+                 excluded_topics: Sequence[str] = (),
+                 partition_size_threshold_mb: float = float("inf"),
+                 load_monitor: Optional[LoadMonitor] = None):
+        self._md = metadata_client
+        self._rf = desired_rf
+        self._excluded = set(excluded_topics)
+        self._size_threshold = partition_size_threshold_mb
+        self._lm = load_monitor
+
+    def detect(self, now_ms: int) -> List[Anomaly]:
+        out: List[Anomaly] = []
+        cluster = self._md.cluster()
+        bad: Dict[str, int] = {}
+        for p in cluster.partitions:
+            if p.topic in self._excluded:
+                continue
+            if len(p.replicas) != self._rf:
+                bad[p.topic] = len(p.replicas)
+        if bad:
+            out.append(TopicReplicationFactorAnomaly(
+                detection_time_ms=now_ms, bad_topics=bad, desired_rf=self._rf))
+        if self._lm is not None and np.isfinite(self._size_threshold):
+            agg = self._lm.partition_aggregator.aggregate()
+            mid = KAFKA_METRIC_DEF.metric_info("DISK_USAGE").metric_id
+            oversized = {}
+            for row, tp in enumerate(agg.entities):
+                if agg.entity_valid[row] and agg.collapsed[row, mid] > self._size_threshold:
+                    oversized[f"{tp[0]}-{tp[1]}"] = float(agg.collapsed[row, mid])
+            if oversized:
+                out.append(TopicPartitionSizeAnomaly(
+                    detection_time_ms=now_ms, oversized=oversized,
+                    size_threshold_mb=self._size_threshold))
+        return out
+
+
+class MaintenanceEventReader:
+    """Queue-backed plan source (MaintenanceEventTopicReader analogue);
+    operators publish plans via the API layer."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue: deque = deque()
+
+    def publish(self, event: MaintenanceEvent) -> None:
+        with self._lock:
+            self._queue.append(event)
+
+    def drain(self) -> List[MaintenanceEvent]:
+        with self._lock:
+            out = list(self._queue)
+            self._queue.clear()
+            return out
+
+
+class MaintenanceEventDetector:
+    def __init__(self, reader: MaintenanceEventReader,
+                 idempotence_ttl_ms: int = 3600_000):
+        self._reader = reader
+        self._ttl = idempotence_ttl_ms
+        self._seen: Dict[Tuple, int] = {}
+
+    def detect(self, now_ms: int) -> List[MaintenanceEvent]:
+        for k, t in list(self._seen.items()):
+            if now_ms - t > self._ttl:
+                del self._seen[k]
+        out = []
+        for ev in self._reader.drain():
+            key = ev.dedup_key()
+            if key in self._seen:
+                continue  # IdempotenceCache drop
+            self._seen[key] = now_ms
+            out.append(ev)
+        return out

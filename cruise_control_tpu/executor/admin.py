@@ -65,6 +65,11 @@ class ClusterAdmin:
     def min_isr(self, topic: str) -> int:
         return 1
 
+    def describe_logdirs(self) -> Dict[int, Dict[str, bool]]:
+        """broker → {logdir → is_online} (ExecutorAdminUtils/DiskFailureDetector
+        describeLogDirs path)."""
+        return {}
+
 
 class InMemoryClusterAdmin(ClusterAdmin):
     """Applies reassignments against a ``MetadataClient``-held metadata
@@ -81,6 +86,8 @@ class InMemoryClusterAdmin(ClusterAdmin):
         self._logdir_moves: List[Tuple[Tp, int, str]] = []
         self.throttle_state: Dict[str, object] = {}
         self.throttle_history: List[Dict[str, object]] = []
+        # broker → {logdir → online}; tests flip entries to simulate disk death.
+        self.logdir_health: Dict[int, Dict[str, bool]] = {}
 
     # -- reassignment ------------------------------------------------------
     def alter_partition_reassignments(self, requests: Sequence[ReassignmentRequest]) -> None:
@@ -166,6 +173,12 @@ class InMemoryClusterAdmin(ClusterAdmin):
                                       "brokers": sorted(brokers),
                                       "replicas": {t: sorted(e) for t, e in
                                                    throttled_replicas.items()}})
+
+    def describe_logdirs(self) -> Dict[int, Dict[str, bool]]:
+        if self.logdir_health:
+            return {b: dict(d) for b, d in self.logdir_health.items()}
+        return {b.broker_id: {ld: True for ld in b.logdirs}
+                for b in self._md.cluster().brokers}
 
     def clear_replication_throttles(self, brokers, throttled_replicas) -> None:
         state = self.throttle_state

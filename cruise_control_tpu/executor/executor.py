@@ -103,7 +103,8 @@ class Executor:
                  removed_broker_retention_ms: int = 12 * 3600 * 1000,
                  on_sampling_pause: Optional[Callable[[str], None]] = None,
                  on_sampling_resume: Optional[Callable[[], None]] = None,
-                 logdir_by_disk: Optional[Dict[int, str]] = None):
+                 logdir_by_disk: Optional[Dict[int, str]] = None,
+                 min_isr_pressure_fn: Optional[Callable[[], bool]] = None):
         self._admin = admin
         self._metadata = metadata_client
         self._limits = limits or ConcurrencyLimits()
@@ -120,6 +121,7 @@ class Executor:
         self._on_pause = on_sampling_pause
         self._on_resume = on_sampling_resume
         self._logdir_by_disk = logdir_by_disk or {}
+        self._min_isr_pressure_fn = min_isr_pressure_fn or (lambda: False)
         self._task_manager: Optional[ExecutionTaskManager] = None
         self._adjuster = ConcurrencyAdjuster(self._limits)
 
@@ -339,7 +341,9 @@ class Executor:
                         del submitted[t.execution_id]
             polls += 1
             if metrics_fn is not None:
-                tm.set_limits(self._adjuster.adjust(tm.limits, metrics_fn()))
+                tm.set_limits(self._adjuster.adjust(
+                    tm.limits, metrics_fn(),
+                    has_min_isr_pressure=self._min_isr_pressure_fn()))
             if not submitted:
                 pending = [t for t in tm._plan.inter_broker_tasks
                            if t.state == TaskState.PENDING]

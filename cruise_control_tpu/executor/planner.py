@@ -53,7 +53,9 @@ class ExecutionTaskPlanner:
         for p in proposals:
             if p.replicas_to_add or p.replicas_to_remove:
                 inter.append(self._new_task(p, TaskType.INTER_BROKER_REPLICA_ACTION))
-            elif p._intra_broker_moves():
+            # Not elif: a proposal can carry both an inter-broker change and a
+            # same-broker disk move for a different replica of the partition.
+            if p._intra_broker_moves():
                 intra.append(self._new_task(p, TaskType.INTRA_BROKER_REPLICA_ACTION))
             if p.has_leader_action:
                 leader.append(self._new_task(p, TaskType.LEADER_ACTION))

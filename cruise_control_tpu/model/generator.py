@@ -40,6 +40,7 @@ class ClusterSpec:
     nw_in_capacity: float = 200000.0
     nw_out_capacity: float = 200000.0
     disk_capacity: float = 1000000.0
+    disks_per_broker: int = 1  # > 1 builds a JBOD topology
     seed: int = 0
 
 
@@ -109,7 +110,19 @@ def generate_cluster(spec: ClusterSpec, pad_replicas_to: Optional[int] = None,
                   spec.disk_capacity], np.float32), (B, 1))
     broker_rack = (np.arange(B) % spec.num_racks).astype(np.int32)
 
+    disk_broker = disk_capacity = replica_disk = None
+    if spec.disks_per_broker > 1:
+        dpb = spec.disks_per_broker
+        disk_broker = np.repeat(np.arange(B, dtype=np.int32), dpb)
+        disk_capacity = np.full(B * dpb, spec.disk_capacity / dpb, np.float32)
+        # Skewed initial disk placement so intra-broker goals have work.
+        replica_disk = (replica_broker * dpb
+                        + (rng.random(R) ** 2 * dpb).astype(np.int32)).astype(np.int32)
+
     return build_model(
+        disk_broker=disk_broker,
+        disk_capacity=disk_capacity,
+        replica_disk=replica_disk,
         replica_broker=replica_broker,
         replica_partition=replica_partition,
         replica_topic=replica_topic,

@@ -78,6 +78,7 @@ class TensorClusterModel:
     disk_capacity: Array  # f32[D], < 0 means dead disk
     disk_valid: Array  # bool[D]
     broker_first_disk: Array  # i32[B] — default landing disk for inter-broker moves
+    broker_disks: Array  # i32[B, max_disks_per_broker] disk ids (-1 pad)
 
     # --- partition axis (P) ---
     partition_topic: Array  # i32[P]
@@ -141,6 +142,19 @@ class TensorClusterModel:
         """i32[B] leader replicas per broker."""
         return masked_segment_count(self.replica_broker, self.num_brokers,
                                     self.replica_valid & self.replica_is_leader)
+
+    def topic_leader_counts(self) -> Array:
+        """i32[T, B] leaders of each topic on each broker
+        (MinTopicLeadersPerBrokerGoal input, goals/MinTopicLeadersPerBrokerGoal.java:50)."""
+        flat = self.replica_topic * self.num_brokers + self.replica_broker
+        counts = masked_segment_count(flat, self.num_topics * self.num_brokers,
+                                      self.replica_valid & self.replica_is_leader)
+        return counts.reshape(self.num_topics, self.num_brokers)
+
+    def preferred_leader_replica(self) -> Array:
+        """i32[P] the preferred (first-listed) replica of each partition
+        (PreferredLeaderElectionGoal.java:36 — replica[0] should lead)."""
+        return self.partition_replicas[:, 0]
 
     def broker_leader_bytes_in(self) -> Array:
         """f32[B] leader NW_IN per broker (LeaderBytesInDistributionGoal input)."""
@@ -375,12 +389,19 @@ def build_model(
         assert disk_capacity is not None and replica_disk is not None
         disk_valid = np.ones(disk_broker.shape[0], bool)
     D = int(disk_broker.shape[0])
-    # Default landing disk per broker: lowest disk index owned by the broker.
+    # Default landing disk per broker: lowest disk index owned by the broker;
+    # plus the padded broker→disks table for intra-broker candidate generation.
     broker_first_disk = np.zeros(Bp, np.int32)
+    disks_of: dict = {}
     for d in range(D - 1, -1, -1):
         b = int(disk_broker[d])
         if 0 <= b < Bp:
             broker_first_disk[b] = d
+            disks_of.setdefault(b, []).insert(0, d)
+    max_dpb = max((len(v) for v in disks_of.values()), default=1)
+    broker_disks = np.full((Bp, max_dpb), -1, np.int32)
+    for b, ds in disks_of.items():
+        broker_disks[b, : len(ds)] = ds
 
     def pad(arr, n, fill=0):
         out = np.full((n,) + arr.shape[1:], fill, arr.dtype)
@@ -422,6 +443,7 @@ def build_model(
         disk_capacity=jnp.asarray(disk_capacity.astype(np.float32)),
         disk_valid=jnp.asarray(disk_valid),
         broker_first_disk=jnp.asarray(broker_first_disk),
+        broker_disks=jnp.asarray(broker_disks),
         partition_topic=jnp.asarray(partition_topic.astype(np.int32)),
         partition_valid=jnp.asarray(np.ones(P, bool)),
         partition_replicas=jnp.asarray(partition_replicas),

@@ -1,0 +1,285 @@
+"""Detector tests: detection logic, notifier policy, manager queue/handling
+(the AnomalyDetectorManagerTest / SlowBrokerFinderTest translation, with a
+recording facade stub instead of EasyMock'd KafkaCruiseControl).
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.detector.anomalies import (AnomalyType, BrokerFailures,
+                                                   GoalViolations, MaintenanceEvent,
+                                                   MaintenancePlanType)
+from cruise_control_tpu.detector.detectors import (BrokerFailureDetector,
+                                                   DiskFailureDetector,
+                                                   GoalViolationDetector,
+                                                   MaintenanceEventDetector,
+                                                   MaintenanceEventReader,
+                                                   PercentileMetricAnomalyFinder,
+                                                   SlowBrokerFinder,
+                                                   TopicAnomalyDetector)
+from cruise_control_tpu.detector.manager import AnomalyDetectorManager
+from cruise_control_tpu.detector.notifier import (AnomalyNotificationAction,
+                                                  SelfHealingNotifier)
+from cruise_control_tpu.executor.admin import InMemoryClusterAdmin
+from cruise_control_tpu.monitor.aggregator import MetricSampleAggregator
+from cruise_control_tpu.monitor.capacity import StaticCapacityResolver
+from cruise_control_tpu.monitor.load_monitor import LoadMonitor
+from cruise_control_tpu.monitor.metadata import (BrokerInfo, ClusterMetadata,
+                                                 MetadataClient, PartitionInfo)
+from cruise_control_tpu.monitor.sampling import SyntheticWorkloadSampler
+
+W = 300_000
+
+
+class RecordingFacade:
+    """Stub facade recording self-healing calls (EasyMock replacement)."""
+
+    def __init__(self, succeed=True):
+        self.calls = []
+        self._succeed = succeed
+
+    def __getattr__(self, name):
+        def call(*args, **kwargs):
+            self.calls.append((name, args, kwargs))
+            return self._succeed
+        return call
+
+
+def make_md(num_brokers=4, rf=2, alive=None):
+    alive = alive if alive is not None else set(range(num_brokers))
+    brokers = tuple(BrokerInfo(i, rack=f"r{i % 2}", host=f"h{i}",
+                               is_alive=(i in alive))
+                    for i in range(num_brokers))
+    parts = []
+    for t in range(2):
+        for p in range(6):
+            reps = tuple((t + p + k) % num_brokers for k in range(rf))
+            parts.append(PartitionInfo(f"t{t}", p, leader=reps[0], replicas=reps))
+    return ClusterMetadata(brokers=brokers, partitions=tuple(parts))
+
+
+def sampled_lm(md, windows=3):
+    lm = LoadMonitor(MetadataClient(md), StaticCapacityResolver(),
+                     num_partition_windows=windows, partition_window_ms=W)
+    lm.start_up()
+    s = SyntheticWorkloadSampler()
+    for w in range(windows + 1):
+        lm.fetch_once(s, w * W, w * W + 1)
+    return lm
+
+
+# -- broker failure ---------------------------------------------------------
+
+def test_broker_failure_detection_and_persistence(tmp_path):
+    path = os.path.join(tmp_path, "failed.json")
+    md = make_md()
+    mc = MetadataClient(md)
+    det = BrokerFailureDetector(mc, persist_path=path)
+    assert det.detect(now_ms=1000) is None
+    # Broker 2 dies.
+    mc.refresh(dataclasses.replace(md, brokers=tuple(
+        dataclasses.replace(b, is_alive=(b.broker_id != 2)) for b in md.brokers)))
+    a = det.detect(now_ms=2000)
+    assert a is not None and a.failed_brokers == {2: 2000}
+    # Failure time survives detector restart (ZK-persistence analogue).
+    det2 = BrokerFailureDetector(mc, persist_path=path)
+    a2 = det2.detect(now_ms=9000)
+    assert a2.failed_brokers == {2: 2000}
+    # Recovery clears it.
+    mc.refresh(md)
+    assert det2.detect(now_ms=10_000) is None
+
+
+def test_broker_failure_notifier_two_stage():
+    n = SelfHealingNotifier(
+        self_healing_enabled={AnomalyType.BROKER_FAILURE: True},
+        broker_failure_alert_threshold_ms=1000,
+        broker_failure_self_healing_threshold_ms=5000)
+    a = BrokerFailures(detection_time_ms=0, failed_brokers={1: 0})
+    assert n.on_anomaly(a, now_ms=500).action == AnomalyNotificationAction.CHECK
+    r = n.on_anomaly(a, now_ms=2000)
+    assert r.action == AnomalyNotificationAction.CHECK and r.delay_ms == 3000
+    assert n.on_anomaly(a, now_ms=6000).action == AnomalyNotificationAction.FIX
+    # Disabled self-healing only alerts.
+    n2 = SelfHealingNotifier(broker_failure_alert_threshold_ms=1000,
+                             broker_failure_self_healing_threshold_ms=5000)
+    assert n2.on_anomaly(a, now_ms=6000).action == AnomalyNotificationAction.IGNORE
+    assert n2.alerts
+
+
+# -- goal violation ---------------------------------------------------------
+
+def test_goal_violation_detector_fixable():
+    lm = sampled_lm(make_md())
+    det = GoalViolationDetector(lm, ["ReplicaDistributionGoal",
+                                     "LeaderReplicaDistributionGoal"])
+    a = det.detect(now_ms=1)
+    # Round-robin metadata is balanced: expect no violation...
+    if a is not None:
+        assert a.fixable_goals or a.unfixable_goals
+
+
+def test_goal_violation_detector_skips_offline():
+    md = make_md(alive={0, 1, 2})  # broker 3 dead → offline replicas
+    lm = sampled_lm(md)
+    det = GoalViolationDetector(lm, ["ReplicaDistributionGoal"])
+    assert det.detect(now_ms=1) is None
+
+
+def test_goal_violation_unfixable_rack():
+    # RF 3 > 2 racks → rack goal unfixable.
+    md = make_md(num_brokers=4, rf=3)
+    lm = sampled_lm(md)
+    det = GoalViolationDetector(lm, ["RackAwareGoal"])
+    a = det.detect(now_ms=1)
+    assert a is not None and "RackAwareGoal" in a.unfixable_goals
+
+
+# -- disk failure -----------------------------------------------------------
+
+def test_disk_failure_detector():
+    md = make_md()
+    mc = MetadataClient(md)
+    admin = InMemoryClusterAdmin(mc)
+    det = DiskFailureDetector(admin, mc)
+    assert det.detect(1) is None
+    admin.logdir_health = {0: {"/d1": True, "/d2": False}, 1: {"/d1": True}}
+    a = det.detect(2)
+    assert a.failed_disks == {0: ("/d2",)}
+
+
+# -- metric anomaly / slow broker -------------------------------------------
+
+def broker_agg_with_history(values_by_broker, windows=6):
+    agg = MetricSampleAggregator(windows, W)
+    for w in range(windows):
+        for b, series in values_by_broker.items():
+            agg.add_sample(b, w * W + 1, {
+                "BROKER_LOG_FLUSH_TIME_MS_999TH": series[w],
+                "LEADER_BYTES_IN": 100.0})
+    # open current window
+    for b in values_by_broker:
+        agg.add_sample(b, windows * W, {"BROKER_LOG_FLUSH_TIME_MS_999TH": 0.0,
+                                        "LEADER_BYTES_IN": 100.0})
+    return agg
+
+
+def test_percentile_finder():
+    agg = broker_agg_with_history({
+        0: [5, 5, 5, 5, 5, 50],   # spike in latest window
+        1: [5, 5, 5, 5, 5, 5],
+    })
+    finder = PercentileMetricAnomalyFinder("BROKER_LOG_FLUSH_TIME_MS_999TH")
+    out = finder.anomalies(agg)
+    assert 0 in out and 1 not in out
+
+
+def test_slow_broker_finder_escalation():
+    slow_series = {0: [5, 5, 5, 5, 5, 100],
+                   1: [5, 5, 5, 5, 5, 5],
+                   2: [5, 5, 5, 5, 5, 6],
+                   3: [5, 5, 5, 5, 5, 5]}
+    finder = SlowBrokerFinder(demote_score=2, removal_score=4)
+    a = None
+    for i in range(2):
+        a = finder.detect(broker_agg_with_history(slow_series), now_ms=i)
+    assert a is not None and not a.fix_by_removal and 0 in a.slow_brokers
+    for i in range(2, 4):
+        a = finder.detect(broker_agg_with_history(slow_series), now_ms=i)
+    assert a.fix_by_removal and 0 in a.slow_brokers
+
+
+def test_slow_broker_finder_systemic_null():
+    # All brokers slow at once → systemic → nothing reported.
+    all_slow = {b: [5, 5, 5, 5, 5, 100] for b in range(4)}
+    finder = SlowBrokerFinder()
+    assert finder.detect(broker_agg_with_history(all_slow), now_ms=1) is None
+
+
+# -- topic anomaly ----------------------------------------------------------
+
+def test_topic_rf_anomaly():
+    md = make_md(rf=2)
+    det = TopicAnomalyDetector(MetadataClient(md), desired_rf=3)
+    out = det.detect(1)
+    assert out and out[0].bad_topics == {"t0": 2, "t1": 2}
+    facade = RecordingFacade()
+    assert out[0].fix(facade)
+    assert facade.calls[0][0] == "update_topic_replication_factor"
+
+
+# -- maintenance events ------------------------------------------------------
+
+def test_maintenance_event_idempotence():
+    reader = MaintenanceEventReader()
+    det = MaintenanceEventDetector(reader, idempotence_ttl_ms=10_000)
+    ev = MaintenanceEvent(detection_time_ms=0,
+                          plan_type=MaintenancePlanType.REMOVE_BROKER, brokers=(3,))
+    dup = MaintenanceEvent(detection_time_ms=1,
+                           plan_type=MaintenancePlanType.REMOVE_BROKER, brokers=(3,))
+    reader.publish(ev)
+    reader.publish(dup)
+    out = det.detect(now_ms=100)
+    assert len(out) == 1  # dedup
+    reader.publish(MaintenanceEvent(detection_time_ms=2,
+                                    plan_type=MaintenancePlanType.REMOVE_BROKER,
+                                    brokers=(3,)))
+    assert det.detect(now_ms=200) == []          # still cached
+    reader.publish(MaintenanceEvent(detection_time_ms=3,
+                                    plan_type=MaintenancePlanType.REMOVE_BROKER,
+                                    brokers=(3,)))
+    assert len(det.detect(now_ms=20_000)) == 1   # TTL expired
+
+
+# -- manager ----------------------------------------------------------------
+
+def test_manager_priority_and_fix():
+    facade = RecordingFacade()
+    notifier = SelfHealingNotifier(
+        self_healing_enabled=dict.fromkeys(AnomalyType, True),
+        broker_failure_alert_threshold_ms=0,
+        broker_failure_self_healing_threshold_ms=0)
+    mgr = AnomalyDetectorManager(notifier, facade)
+    gv = GoalViolations(detection_time_ms=1, fixable_goals=["ReplicaDistributionGoal"])
+    bf = BrokerFailures(detection_time_ms=1, failed_brokers={2: 0})
+    mgr.enqueue(gv, 1)
+    mgr.enqueue(bf, 1)
+    mgr.handle_anomalies_once(now_ms=10)
+    # Broker failure (priority 0) handled before goal violation.
+    assert facade.calls[0][0] == "remove_brokers"
+    assert facade.calls[1][0] == "rebalance"
+    st = mgr.state.to_dict(notifier)
+    assert st["metrics"]["num_broker_failure"] == 1
+    assert st["recentAnomalies"]["GOAL_VIOLATION"][0]["status"] == "FIX_STARTED"
+
+
+def test_manager_defers_when_executor_busy():
+    facade = RecordingFacade()
+    notifier = SelfHealingNotifier(self_healing_enabled=dict.fromkeys(AnomalyType, True))
+    busy = {"v": True}
+    mgr = AnomalyDetectorManager(notifier, facade, executor_busy=lambda: busy["v"])
+    mgr.enqueue(GoalViolations(detection_time_ms=1, fixable_goals=["X"]), 1)
+    mgr.handle_anomalies_once(now_ms=10)
+    assert not facade.calls  # deferred
+    busy["v"] = False
+    mgr.handle_anomalies_once(now_ms=50_000)
+    assert facade.calls and facade.calls[0][0] == "rebalance"
+
+
+def test_manager_detector_intervals():
+    class CountingDetector:
+        def __init__(self):
+            self.runs = 0
+        def detect(self, now_ms):
+            self.runs += 1
+            return None
+    det = CountingDetector()
+    mgr = AnomalyDetectorManager()
+    mgr.register_detector(det, interval_ms=1000)
+    mgr.run_detectors_once(0)
+    mgr.run_detectors_once(500)   # too soon
+    mgr.run_detectors_once(1500)
+    assert det.runs == 2

@@ -1,0 +1,148 @@
+"""Tests for the extended goal families: preferred leader election,
+min-topic-leaders, intra-broker disk goals, kafka-assigner modes, and
+provisioning verdicts.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import optimizer as opt
+from cruise_control_tpu.analyzer.balancing_constraint import BalancingConstraint
+from cruise_control_tpu.analyzer.goals.specs import (DEFAULT_GOAL_ORDER,
+                                                     DEFAULT_HARD_GOALS,
+                                                     GOAL_SPECS,
+                                                     INTRA_BROKER_GOAL_ORDER)
+from cruise_control_tpu.analyzer.provisioning import ProvisionStatus
+from cruise_control_tpu.analyzer.verifier import verify_run
+from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster
+from cruise_control_tpu.model.tensor_model import BrokerState
+
+
+def test_default_goal_order_registered():
+    for name in DEFAULT_GOAL_ORDER + INTRA_BROKER_GOAL_ORDER:
+        assert name in GOAL_SPECS
+    assert "RackAwareGoal" in DEFAULT_HARD_GOALS
+    assert "MinTopicLeadersPerBrokerGoal" in DEFAULT_HARD_GOALS
+
+
+def test_preferred_leader_election():
+    model = generate_cluster(ClusterSpec(num_brokers=5, num_racks=5, num_topics=3,
+                                         mean_partitions_per_topic=8.0, seed=21))
+    # Break preferred leadership: make the second replica lead everywhere.
+    import jax.numpy as jnp
+    pr = np.asarray(model.partition_replicas)
+    lead = np.zeros(model.num_replicas_padded, bool)
+    lead[pr[pr[:, 1] >= 0][:, 1]] = True
+    # Partitions with RF=1 keep replica 0 as leader.
+    solo = pr[:, 1] < 0
+    lead[pr[solo][:, 0]] = True
+    model = model.replace(replica_is_leader=jnp.asarray(lead))
+    model.sanity_check()
+
+    run = opt.optimize(model, ["PreferredLeaderElectionGoal"],
+                       raise_on_hard_failure=False)
+    final = run.model
+    lead2 = np.asarray(final.replica_is_leader)
+    pr2 = np.asarray(final.partition_replicas)
+    rf_ok = pr2[:, 0] >= 0
+    assert lead2[pr2[rf_ok][:, 0]].all(), "preferred replicas must lead"
+    # No replica movement — leadership only.
+    assert (np.asarray(final.replica_broker) == np.asarray(model.replica_broker)).all()
+
+
+def test_min_topic_leaders_per_broker():
+    model = generate_cluster(ClusterSpec(num_brokers=4, num_racks=4, num_topics=3,
+                                         mean_partitions_per_topic=10.0,
+                                         replication_factor=3, seed=8))
+    con = dataclasses.replace(BalancingConstraint.default(),
+                              min_topic_leaders_per_broker=1,
+                              min_leader_topic_ids=(0,))
+    run = opt.optimize(model, ["MinTopicLeadersPerBrokerGoal"], constraint=con,
+                       raise_on_hard_failure=False)
+    tlc = np.asarray(run.model.topic_leader_counts())
+    assert (tlc[0] >= 1).all(), f"every broker needs >=1 leader of topic 0, got {tlc[0]}"
+    assert run.goal_results[0].satisfied_after
+
+
+def test_intra_broker_disk_goals():
+    model = generate_cluster(ClusterSpec(num_brokers=4, num_racks=2, num_topics=4,
+                                         mean_partitions_per_topic=15.0,
+                                         disks_per_broker=4, seed=12))
+    model.sanity_check()
+    run = opt.optimize(model, INTRA_BROKER_GOAL_ORDER, raise_on_hard_failure=False)
+    final = run.model
+    final.sanity_check()
+    # Replica→broker placement untouched (intra-broker only).
+    assert (np.asarray(final.replica_broker) == np.asarray(model.replica_broker)).all()
+    # Disk placement changed and balance improved.
+    moved = (np.asarray(final.replica_disk) != np.asarray(model.replica_disk)).sum()
+    assert moved > 0
+    def spread(m):
+        dl = np.asarray(m.disk_load())
+        cap = np.asarray(m.disk_capacity)
+        pct = dl / cap
+        return pct.max() - pct.min()
+    assert spread(final) < spread(model)
+
+
+def test_intra_disk_capacity_heals_dead_disk():
+    model = generate_cluster(ClusterSpec(num_brokers=3, num_racks=3, num_topics=2,
+                                         mean_partitions_per_topic=10.0,
+                                         disks_per_broker=3, seed=4))
+    import jax.numpy as jnp
+    # Kill disk 0 (broker 0).
+    dead_cap = np.asarray(model.disk_capacity).copy()
+    dead_cap[0] = -1.0
+    model = model.replace(disk_capacity=jnp.asarray(dead_cap))
+    assert np.asarray(model.replica_offline_now()).sum() > 0
+    run = opt.optimize(model, ["IntraBrokerDiskCapacityGoal"],
+                       raise_on_hard_failure=False)
+    rd = np.asarray(run.model.replica_disk)
+    valid = np.asarray(run.model.replica_valid)
+    assert not (rd[valid] == 0).any(), "dead disk must be drained"
+
+
+def test_kafka_assigner_mode_goals():
+    model = generate_cluster(ClusterSpec(num_brokers=6, num_racks=3,
+                                         distribution="exponential", seed=17))
+    names = ["KafkaAssignerEvenRackAwareGoal", "KafkaAssignerDiskUsageDistributionGoal"]
+    run = opt.optimize(model, names, raise_on_hard_failure=False)
+    verify_run(model, run, names)
+    assert np.asarray(run.model.partition_rack_counts()).max() <= 1
+
+
+def test_provision_under_provisioned():
+    # Tiny disk capacity → DiskCapacityGoal unsatisfiable → UNDER_PROVISIONED.
+    model = generate_cluster(ClusterSpec(num_brokers=3, num_racks=3,
+                                         disk_capacity=500.0, seed=3))
+    run = opt.optimize(model, ["DiskCapacityGoal"], raise_on_hard_failure=False)
+    assert not run.goal_results[0].satisfied_after
+    assert run.provision_response.status == ProvisionStatus.UNDER_PROVISIONED
+    rec = run.provision_response.recommendations[0]
+    assert rec.num_brokers >= 1 and rec.resource == 3
+
+
+def test_provision_over_provisioned():
+    con = dataclasses.replace(
+        BalancingConstraint.default(),
+        low_utilization_threshold=(0.0, 0.0, 0.0, 0.9))
+    model = generate_cluster(ClusterSpec(num_brokers=10, num_racks=5,
+                                         disk_capacity=10_000_000.0, seed=3))
+    run = opt.optimize(model, ["DiskUsageDistributionGoal"], constraint=con,
+                       raise_on_hard_failure=False)
+    assert run.provision_response.status == ProvisionStatus.OVER_PROVISIONED
+    assert run.provision_response.recommendations[0].num_brokers > 0
+
+
+def test_full_default_stack_with_new_goals():
+    con = dataclasses.replace(BalancingConstraint.default(),
+                              min_leader_topic_ids=(1,))
+    model = generate_cluster(ClusterSpec(num_brokers=6, num_racks=3, num_topics=4,
+                                         mean_partitions_per_topic=12.0,
+                                         replication_factor=3,
+                                         distribution="linear", seed=33))
+    run = opt.optimize(model, DEFAULT_GOAL_ORDER, constraint=con,
+                       raise_on_hard_failure=False)
+    verify_run(model, run, DEFAULT_GOAL_ORDER, constraint=con)
