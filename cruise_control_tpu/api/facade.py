@@ -1,0 +1,376 @@
+"""The CruiseControl facade: one object tying monitor → analyzer → executor
+→ detectors together.
+
+Parity with ``KafkaCruiseControl`` (KafkaCruiseControl.java:73) +
+``GoalOptimizer``'s proposal cache (GoalOptimizer.java:63: precomputed
+proposals invalidated by model generation, ``optimizations`` cached path
+:291-339): admin operations (rebalance, add/remove/demote brokers, fix
+offline replicas, topic RF update) each build a cluster model, run the goal
+stack under operation-specific options, and optionally execute — exactly
+the servlet runnables' computeResult flow
+(GoalBasedOperationRunnable.java:153-186, RebalanceRunnable.java:109-123,
+AddBrokersRunnable / RemoveBrokersRunnable / DemoteBrokerRunnable /
+FixOfflineReplicasRunnable / UpdateTopicConfigurationRunnable).
+
+This facade is also the self-healing context consumed by
+``detector.anomalies`` fix() methods.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from cruise_control_tpu.analyzer import optimizer as opt
+from cruise_control_tpu.analyzer import proposals as props
+from cruise_control_tpu.analyzer.balancing_constraint import BalancingConstraint
+from cruise_control_tpu.analyzer.goals.specs import (DEFAULT_GOAL_ORDER,
+                                                     DEFAULT_HARD_GOALS, GOAL_SPECS)
+from cruise_control_tpu.analyzer.state import OptimizationOptions
+from cruise_control_tpu.analyzer.verifier import VerificationError, verify_run
+from cruise_control_tpu.executor.admin import ClusterAdmin, ReassignmentRequest
+from cruise_control_tpu.executor.executor import Executor, OngoingExecutionError
+from cruise_control_tpu.model.stats import compute_stats
+from cruise_control_tpu.model.tensor_model import BrokerState, TensorClusterModel
+from cruise_control_tpu.monitor.load_monitor import (LoadMonitor,
+                                                     ModelCompletenessRequirements)
+
+
+@dataclasses.dataclass
+class OperationResult:
+    """OptimizationResult JSON payload (servlet/response/OptimizationResult)."""
+
+    ok: bool
+    dryrun: bool
+    proposals: List[props.ExecutionProposal]
+    violated_goals_before: List[str]
+    violated_goals_after: List[str]
+    provision_status: str
+    stats_before: Dict[str, object]
+    stats_after: Dict[str, object]
+    execution: Optional[object] = None  # ExecutionResult when not dryrun
+    reason: str = ""
+
+    def to_dict(self) -> Dict[str, object]:
+        out = {
+            "ok": self.ok,
+            "dryrun": self.dryrun,
+            "numProposals": len(self.proposals),
+            "proposals": [p.to_dict() for p in self.proposals[:200]],
+            "violatedGoalsBefore": self.violated_goals_before,
+            "violatedGoalsAfter": self.violated_goals_after,
+            "provisionStatus": self.provision_status,
+            "statsBefore": self.stats_before,
+            "statsAfter": self.stats_after,
+            "reason": self.reason,
+        }
+        if self.execution is not None:
+            out["execution"] = dataclasses.asdict(self.execution)
+        return out
+
+
+class CruiseControl:
+    def __init__(self, load_monitor: LoadMonitor, executor: Executor,
+                 admin: ClusterAdmin,
+                 goals: Optional[Sequence[str]] = None,
+                 hard_goals: Optional[Sequence[str]] = None,
+                 constraint: Optional[BalancingConstraint] = None,
+                 requirements: Optional[ModelCompletenessRequirements] = None,
+                 proposal_expiration_ms: int = 60_000):
+        self.load_monitor = load_monitor
+        self.executor = executor
+        self.admin = admin
+        self.goals = list(goals or DEFAULT_GOAL_ORDER)
+        self.hard_goals = list(hard_goals or DEFAULT_HARD_GOALS)
+        self.constraint = constraint or BalancingConstraint.default()
+        self.requirements = requirements or ModelCompletenessRequirements()
+        self._proposal_expiration_ms = proposal_expiration_ms
+        self._cache_lock = threading.Lock()
+        self._cached: Optional[Tuple[Tuple[int, int], float, opt.OptimizerRun,
+                                     List[props.ExecutionProposal]]] = None
+
+    # ------------------------------------------------------------------
+    # Model + optimization plumbing
+    # ------------------------------------------------------------------
+    def _model(self) -> TensorClusterModel:
+        return self.load_monitor.cluster_model(self.requirements)
+
+    def _optimize(self, model: TensorClusterModel, goals: Optional[Sequence[str]],
+                  options: Optional[OptimizationOptions] = None) -> opt.OptimizerRun:
+        goal_list = list(goals) if goals else self.goals
+        # Requested non-hard-only goal subsets still honor hard goals first
+        # (GoalBasedOperationRunnable skip-hard-goal-check semantics are an
+        # explicit flag in the reference; default keeps them).
+        return opt.optimize(model, goal_list, constraint=self.constraint,
+                            options=options, raise_on_hard_failure=False)
+
+    def _finish(self, model: TensorClusterModel, run: opt.OptimizerRun,
+                dryrun: bool, reason: str,
+                verify: bool = True) -> OperationResult:
+        proposals = props.diff(model, run.model)
+        if verify:
+            try:
+                verify_run(model, run, [g.name for g in run.goal_results],
+                           constraint=self.constraint, proposals=proposals)
+            except VerificationError as e:
+                return OperationResult(
+                    ok=False, dryrun=dryrun, proposals=proposals,
+                    violated_goals_before=run.violated_goals_before,
+                    violated_goals_after=run.violated_goals_after,
+                    provision_status=run.provision_response.status.value,
+                    stats_before=run.stats_before.to_dict(),
+                    stats_after=run.stats_after.to_dict(),
+                    reason=f"{reason} [verification failed: {e}]")
+        execution = None
+        ok = True
+        if not dryrun and proposals:
+            execution = self.executor.execute_proposals(
+                proposals, self.load_monitor.naming()["partitions"])
+            ok = execution.ok
+        return OperationResult(
+            ok=ok, dryrun=dryrun, proposals=proposals,
+            violated_goals_before=run.violated_goals_before,
+            violated_goals_after=run.violated_goals_after,
+            provision_status=run.provision_response.status.value,
+            stats_before=run.stats_before.to_dict(),
+            stats_after=run.stats_after.to_dict(),
+            execution=execution, reason=reason)
+
+    # ------------------------------------------------------------------
+    # Proposals (cached)
+    # ------------------------------------------------------------------
+    def proposals(self, goals: Optional[Sequence[str]] = None,
+                  ignore_proposal_cache: bool = False) -> OperationResult:
+        """GET /proposals — cached while the model generation is unchanged
+        and the cache is younger than proposal.expiration.ms."""
+        gen = self.load_monitor.model_generation().as_tuple()
+        use_cache = not ignore_proposal_cache and not goals
+        if use_cache:
+            with self._cache_lock:
+                if self._cached is not None:
+                    cgen, ctime, crun, cprops = self._cached
+                    fresh = (time.monotonic() - ctime) * 1000 < self._proposal_expiration_ms
+                    if cgen == gen and fresh:
+                        return OperationResult(
+                            ok=True, dryrun=True, proposals=cprops,
+                            violated_goals_before=crun.violated_goals_before,
+                            violated_goals_after=crun.violated_goals_after,
+                            provision_status=crun.provision_response.status.value,
+                            stats_before=crun.stats_before.to_dict(),
+                            stats_after=crun.stats_after.to_dict(),
+                            reason="cached")
+        model = self._model()
+        run = self._optimize(model, goals)
+        result = self._finish(model, run, dryrun=True, reason="proposals")
+        # Only verified-good runs are cacheable: a cached entry is always
+        # served with ok=True.
+        if use_cache and result.ok:
+            with self._cache_lock:
+                self._cached = (gen, time.monotonic(), run, result.proposals)
+        return result
+
+    def invalidate_proposal_cache(self) -> None:
+        with self._cache_lock:
+            self._cached = None
+
+    # ------------------------------------------------------------------
+    # Admin operations (also the self-healing context SPI)
+    # ------------------------------------------------------------------
+    def rebalance(self, goals: Optional[Sequence[str]] = None, dryrun: bool = False,
+                  destination_broker_ids: Optional[Sequence[int]] = None,
+                  excluded_topics: Optional[Sequence[int]] = None,
+                  reason: str = "rebalance") -> OperationResult:
+        model = self._model()
+        options = OptimizationOptions.none(model)
+        if destination_broker_ids:
+            mask = np.zeros(model.num_brokers, bool)
+            mask[list(destination_broker_ids)] = True
+            options = options.replace(requested_dest_only=jnp.asarray(mask))
+        if excluded_topics:
+            tmask = np.zeros(model.num_topics, bool)
+            tmask[list(excluded_topics)] = True
+            options = options.replace(topic_excluded=jnp.asarray(tmask))
+        run = self._optimize(model, goals, options)
+        return self._finish(model, run, dryrun, reason)
+
+    def add_brokers(self, broker_ids: Sequence[int], dryrun: bool = False,
+                    reason: str = "add_brokers") -> OperationResult:
+        """Move load onto NEW brokers (AddBrokersRunnable)."""
+        model = self._model()
+        for b in broker_ids:
+            model = model.set_broker_state(b, BrokerState.NEW)
+        self.executor.drop_recently_removed_brokers(list(broker_ids))
+        run = self._optimize(model, self.goals)
+        return self._finish(model, run, dryrun, reason)
+
+    def remove_brokers(self, broker_ids: Sequence[int], dryrun: bool = False,
+                       reason: str = "remove_brokers") -> bool:
+        """Decommission: drain all replicas off the brokers
+        (RemoveBrokersRunnable)."""
+        model = self._model()
+        for b in broker_ids:
+            model = model.set_broker_state(b, BrokerState.DEAD)
+        run = self._optimize(model, self.goals)
+        result = self._finish(model, run, dryrun, reason)
+        if result.ok and not dryrun:
+            self.executor.add_recently_removed_brokers(list(broker_ids))
+        return result.ok
+
+    def demote_brokers(self, broker_ids: Sequence[int], dryrun: bool = False,
+                       reason: str = "demote_brokers") -> bool:
+        """Move leadership (and preferred-leader order) off the brokers
+        (DemoteBrokerRunnable → PreferredLeaderElectionGoal with demoted
+        exclusions)."""
+        model = self._model()
+        for b in broker_ids:
+            model = model.set_broker_state(b, BrokerState.DEMOTED)
+        options = OptimizationOptions.none(model)
+        mask = np.zeros(model.num_brokers, bool)
+        mask[list(broker_ids)] = True
+        options = options.replace(broker_excluded_leadership=jnp.asarray(mask))
+        run = self._optimize(model, ["LeaderReplicaDistributionGoal"], options)
+        result = self._finish(model, run, dryrun, reason)
+        if result.ok and not dryrun:
+            self.executor.add_recently_demoted_brokers(list(broker_ids))
+        return result.ok
+
+    def fix_offline_replicas(self, dryrun: bool = False,
+                             reason: str = "fix_offline_replicas") -> bool:
+        """Heal offline replicas via the hard-goal stack
+        (FixOfflineReplicasRunnable)."""
+        model = self._model()
+        run = self._optimize(model, self.hard_goals)
+        return self._finish(model, run, dryrun, reason).ok
+
+    def update_topic_replication_factor(self, topics_rf: Dict[str, int],
+                                        dryrun: bool = False,
+                                        reason: str = "topic_rf_update") -> bool:
+        """Set topics to the desired RF (UpdateTopicConfigurationRunnable):
+        grow rack-aware onto least-loaded brokers, shrink by dropping
+        non-leader replicas from most-loaded brokers."""
+        cluster = self.load_monitor._metadata.cluster()
+        model = self._model()
+        load = np.asarray(model.broker_load()).sum(axis=1)
+        naming = self.load_monitor.naming()
+        broker_rack = {b.broker_id: b.rack for b in cluster.brokers}
+        alive = set(cluster.alive_broker_ids())
+        requests = []
+        for p in cluster.partitions:
+            want = topics_rf.get(p.topic)
+            if want is None or len(p.replicas) == want:
+                continue
+            replicas = list(p.replicas)
+            if len(replicas) < want:
+                used_racks = {broker_rack[b] for b in replicas}
+                pool = [b for b in alive if b not in replicas]
+                while len(replicas) < want and pool:
+                    # Re-rank each pick so freshly used racks are deprioritized
+                    # (rack-aware growth, not just a one-shot sort).
+                    pool.sort(key=lambda b: (broker_rack[b] in used_racks,
+                                             load[naming["brokers"].index(b)]))
+                    b = pool.pop(0)
+                    replicas.append(b)
+                    used_racks.add(broker_rack[b])
+            else:
+                followers = [b for b in replicas if b != p.leader]
+                followers.sort(key=lambda b: -load[naming["brokers"].index(b)])
+                for b in followers[: len(replicas) - want]:
+                    replicas.remove(b)
+            requests.append(ReassignmentRequest(tp=p.tp, new_replicas=tuple(replicas)))
+        if not requests:
+            return False
+        if dryrun:
+            return True
+        self.admin.alter_partition_reassignments(requests)
+        deadline = time.monotonic() + 600.0
+        while self.admin.ongoing_reassignments():
+            if time.monotonic() > deadline:
+                return False  # stalled reassignment; leave it to the operator
+            time.sleep(0.01)
+        self.load_monitor._metadata.refresh(self.load_monitor._metadata.cluster())
+        return True
+
+    # ------------------------------------------------------------------
+    # State / control
+    # ------------------------------------------------------------------
+    def state(self, detector_manager=None) -> Dict[str, object]:
+        """GET /state payload (monitor + executor + analyzer + detector)."""
+        lm = self.load_monitor
+        out: Dict[str, object] = {
+            "MonitorState": {
+                "state": lm.state().value,
+                "validWindows": lm.partition_aggregator.valid_windows(),
+                "monitoredPartitionsPercentage": lm.monitored_partitions_percentage(),
+                "pauseReason": lm.pause_reason,
+            },
+            "ExecutorState": self.executor.state_summary(),
+            "AnalyzerState": {
+                "goals": self.goals,
+                "proposalsCached": self._cached is not None,
+            },
+        }
+        if detector_manager is not None:
+            out["AnomalyDetectorState"] = detector_manager.state.to_dict(
+                detector_manager.notifier)
+        return out
+
+    def kafka_cluster_state(self) -> Dict[str, object]:
+        """GET /kafka_cluster_state payload."""
+        cluster = self.load_monitor._metadata.cluster()
+        return {
+            "brokers": [dataclasses.asdict(b) for b in cluster.brokers],
+            "partitions": [
+                {"topic": p.topic, "partition": p.partition, "leader": p.leader,
+                 "replicas": list(p.replicas),
+                 "offlineReplicas": list(p.offline_replicas)}
+                for p in cluster.partitions],
+        }
+
+    def partition_load(self, max_entries: int = 100) -> List[Dict[str, object]]:
+        """GET /partition_load: partitions sorted by utilization."""
+        agg = self.load_monitor.partition_aggregator.aggregate()
+        from cruise_control_tpu.monitor.metricdef import KAFKA_METRIC_DEF
+        rows = []
+        for row, tp in enumerate(agg.entities):
+            if not agg.entity_valid[row]:
+                continue
+            m = {info.name: float(agg.collapsed[row, info.metric_id])
+                 for info in KAFKA_METRIC_DEF.all_metric_infos()[:4]}
+            rows.append({"topic": tp[0], "partition": tp[1], **m})
+        rows.sort(key=lambda r: -r.get("DISK_USAGE", 0.0))
+        return rows[:max_entries]
+
+    def broker_load(self) -> Dict[str, object]:
+        """GET /load: per-broker utilization + stats."""
+        model = self._model()
+        load = np.asarray(model.broker_load())
+        cap = np.asarray(model.broker_capacity)
+        valid = np.asarray(model.broker_valid)
+        brokers = []
+        naming = self.load_monitor.naming()
+        for i, b in enumerate(naming["brokers"]):
+            if not valid[i]:
+                continue
+            brokers.append({
+                "broker": b,
+                "cpu": float(load[i, 0]), "networkInbound": float(load[i, 1]),
+                "networkOutbound": float(load[i, 2]), "disk": float(load[i, 3]),
+                "diskPct": float(load[i, 3] / max(cap[i, 3], 1e-9) * 100),
+                "replicas": int(np.asarray(model.broker_replica_counts())[i]),
+                "leaders": int(np.asarray(model.broker_leader_counts())[i]),
+            })
+        return {"brokers": brokers, "stats": compute_stats(model).to_dict()}
+
+    def stop_proposal_execution(self, force: bool = False) -> None:
+        self.executor.stop_execution(force=force)
+
+    def pause_sampling(self, reason: str = "") -> None:
+        self.load_monitor.pause_sampling(reason)
+
+    def resume_sampling(self) -> None:
+        self.load_monitor.resume_sampling()

@@ -1,0 +1,464 @@
+"""REST API server.
+
+Parity with the servlet layer (servlet/KafkaCruiseControlServlet.java:40 +
+CruiseControlEndPoint.java:16-37): the 20 endpoints in their 4 permission
+groups, served under ``/kafkacruisecontrol/<endpoint>`` by a stdlib
+ThreadingHTTPServer (the Jetty analogue — no external deps):
+
+GET  (KAFKA_MONITOR):   LOAD, PARTITION_LOAD, PROPOSALS, KAFKA_CLUSTER_STATE
+GET  (CC_MONITOR):      STATE, USER_TASKS, REVIEW_BOARD
+POST (KAFKA_ADMIN):     ADD_BROKER, REMOVE_BROKER, FIX_OFFLINE_REPLICAS,
+                        REBALANCE, DEMOTE_BROKER, TOPIC_CONFIGURATION
+POST (CC_ADMIN):        STOP_PROPOSAL_EXECUTION, PAUSE_SAMPLING,
+                        RESUME_SAMPLING, ADMIN, REVIEW, BOOTSTRAP, TRAIN
+
+Long-running operations run through the ``UserTaskManager`` — the response
+carries a ``User-Task-ID`` header; polling the same URL (or ``user_tasks``)
+returns progress until the result is ready (UserTaskManager.java:55-66).
+POST endpoints optionally require 2-step verification via the purgatory
+(``two_step_verification=True``).  Security is a pluggable
+``SecurityProvider`` (servlet/security/SecurityProvider.java) with
+HTTP-Basic and permissive defaults; roles ADMIN > USER > VIEWER.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import json
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from cruise_control_tpu.api.facade import CruiseControl
+from cruise_control_tpu.api.purgatory import Purgatory
+from cruise_control_tpu.api.user_tasks import TaskStatus, UserTaskManager
+from cruise_control_tpu.detector.anomalies import AnomalyType
+
+PREFIX = "/kafkacruisecontrol"
+
+GET_ENDPOINTS = {"bootstrap", "train", "load", "partition_load", "proposals",
+                 "state", "kafka_cluster_state", "user_tasks", "review_board"}
+POST_ENDPOINTS = {"add_broker", "remove_broker", "fix_offline_replicas",
+                  "rebalance", "stop_proposal_execution", "pause_sampling",
+                  "resume_sampling", "demote_broker", "admin", "review",
+                  "topic_configuration"}
+
+# Permission groups (CruiseControlEndPoint.java:16-37).
+ROLE_VIEWER, ROLE_USER, ROLE_ADMIN = "VIEWER", "USER", "ADMIN"
+_ENDPOINT_ROLE = {e: ROLE_VIEWER for e in GET_ENDPOINTS}
+_ENDPOINT_ROLE.update({e: ROLE_ADMIN for e in POST_ENDPOINTS})
+_ENDPOINT_ROLE.update({"user_tasks": ROLE_USER, "review_board": ROLE_USER,
+                       "bootstrap": ROLE_ADMIN, "train": ROLE_ADMIN})
+_ROLE_RANK = {ROLE_VIEWER: 0, ROLE_USER: 1, ROLE_ADMIN: 2}
+
+
+class SecurityProvider:
+    """servlet/security/SecurityProvider.java analogue."""
+
+    def authenticate(self, headers) -> Optional[str]:
+        """Return the caller's role, or None to reject."""
+        return ROLE_ADMIN
+
+
+class BasicSecurityProvider(SecurityProvider):
+    """HTTP Basic (servlet/security/BasicSecurityProvider.java): credentials
+    {user: (password, role)}."""
+
+    def __init__(self, credentials: Dict[str, Tuple[str, str]]):
+        self._creds = credentials
+
+    def authenticate(self, headers) -> Optional[str]:
+        auth = headers.get("Authorization", "")
+        if not auth.startswith("Basic "):
+            return None
+        try:
+            user, pw = base64.b64decode(auth[6:]).decode().split(":", 1)
+        except Exception:  # noqa: BLE001 — malformed header
+            return None
+        entry = self._creds.get(user)
+        if entry is None or entry[0] != pw:
+            return None
+        return entry[1]
+
+
+class BadRequest(Exception):
+    pass
+
+
+def _parse_bool(q: Dict[str, str], key: str, default: bool) -> bool:
+    v = q.get(key)
+    if v is None:
+        return default
+    if v.lower() in ("true", "1", "yes"):
+        return True
+    if v.lower() in ("false", "0", "no"):
+        return False
+    raise BadRequest(f"invalid boolean for {key!r}: {v!r}")
+
+
+def _parse_ids(q: Dict[str, str], key: str) -> List[int]:
+    raw = q.get(key, "")
+    if not raw:
+        return []
+    try:
+        return [int(x) for x in raw.split(",") if x]
+    except ValueError as e:
+        raise BadRequest(f"invalid id list for {key!r}: {raw!r}") from e
+
+
+def _parse_goals(q: Dict[str, str]) -> Optional[List[str]]:
+    raw = q.get("goals", "")
+    return [g for g in raw.split(",") if g] or None
+
+
+class CruiseControlApi:
+    """Endpoint dispatch, decoupled from HTTP plumbing for testability."""
+
+    def __init__(self, cc: CruiseControl, detector_manager=None, sampler=None,
+                 two_step_verification: bool = False,
+                 security: Optional[SecurityProvider] = None):
+        self.cc = cc
+        self.detector_manager = detector_manager
+        self.sampler = sampler
+        self.user_tasks = UserTaskManager()
+        self.purgatory = Purgatory() if two_step_verification else None
+        self.security = security or SecurityProvider()
+        self.request_meters: Dict[str, int] = {}
+        self._local = threading.local()  # per-request purgatory review key
+
+    # -- dispatch ----------------------------------------------------------
+    def handle(self, method: str, endpoint: str, query: Dict[str, str],
+               headers=None) -> Tuple[int, Dict[str, object], Dict[str, str]]:
+        """Returns (http_status, json_body, extra_headers)."""
+        endpoint = endpoint.lower()
+        valid = GET_ENDPOINTS if method == "GET" else POST_ENDPOINTS
+        if endpoint not in valid:
+            return 404, {"error": f"unknown {method} endpoint {endpoint!r}",
+                         "validEndpoints": sorted(valid)}, {}
+        role = self.security.authenticate(headers or {})
+        if role is None:
+            return 401, {"error": "authentication required"}, {}
+        if _ROLE_RANK[role] < _ROLE_RANK[_ENDPOINT_ROLE[endpoint]]:
+            return 403, {"error": f"endpoint {endpoint} requires "
+                                  f"{_ENDPOINT_ROLE[endpoint]}"}, {}
+        self.request_meters[endpoint] = self.request_meters.get(endpoint, 0) + 1
+
+        # Purgatory gate for mutating POSTs (Purgatory.java:43).
+        mutating = endpoint in ("add_broker", "remove_broker", "rebalance",
+                                "demote_broker", "fix_offline_replicas",
+                                "topic_configuration")
+        review_key = None
+        if self.purgatory is not None and method == "POST" and mutating:
+            rid = query.get("review_id")
+            if rid is None:
+                req = self.purgatory.add(endpoint, query)
+                return 202, {"reviewId": req.review_id,
+                             "status": req.status,
+                             "message": "request parked for review"}, {}
+            try:
+                req = self.purgatory.take_approved(int(rid), endpoint)
+            except (KeyError, ValueError) as e:
+                # Polling an already-SUBMITTED review must keep returning the
+                # running/completed task instead of failing the client.
+                task = self.user_tasks.find_by_key(("review", endpoint, int(rid)))
+                if task is not None:
+                    return self._task_response(task)
+                return 400, {"error": str(e)}, {}
+            # Execute EXACTLY the reviewed parameters — overriding them at
+            # resubmission would bypass the review (two-step verification).
+            passthrough = {k: v for k, v in query.items() if k == "max_wait_s"}
+            query = {**req.query, **passthrough}
+            review_key = ("review", endpoint, req.review_id)
+
+        try:
+            self._local.review_key = review_key
+            return getattr(self, f"_ep_{endpoint}")(query)
+        except BadRequest as e:
+            return 400, {"error": str(e)}, {}
+        except Exception as e:  # noqa: BLE001 — servlet-style error payload
+            return 500, {"error": f"{type(e).__name__}: {e}",
+                         "stackTrace": True}, {}
+
+    def _async(self, endpoint: str, query: Dict[str, str],
+               fn: Callable) -> Tuple[int, Dict[str, object], Dict[str, str]]:
+        """Run via UserTaskManager; identical re-requests join while active.
+        A purgatory-approved request is keyed by its review id so it executes
+        exactly once and re-polls keep returning its result."""
+        review_key = getattr(self._local, "review_key", None)
+        key = review_key or (endpoint, tuple(sorted(query.items())))
+        task = self.user_tasks.submit(endpoint, key, fn,
+                                      join_completed=review_key is not None)
+        return self._task_response(task, float(query.get("max_wait_s", "10")))
+
+    def _task_response(self, task, max_wait_s: float = 0.0
+                       ) -> Tuple[int, Dict[str, object], Dict[str, str]]:
+        deadline = time.monotonic() + max_wait_s
+        while task.status == TaskStatus.ACTIVE and time.monotonic() < deadline:
+            time.sleep(0.02)
+        headers = {"User-Task-ID": task.task_id}
+        if task.status == TaskStatus.ACTIVE:
+            return 202, {"progress": task.progress.to_list(),
+                         "userTaskId": task.task_id}, headers
+        if task.status == TaskStatus.COMPLETED_WITH_ERROR:
+            return 500, {"error": task.error, "userTaskId": task.task_id}, headers
+        result = task.result
+        if dataclasses.is_dataclass(result):
+            result = result.to_dict()
+        elif not isinstance(result, (dict, list)):
+            result = {"result": result}
+        return 200, result, headers
+
+    # -- GET endpoints -----------------------------------------------------
+    def _ep_state(self, q):
+        payload = self.cc.state(self.detector_manager)
+        substates = q.get("substates")
+        if substates:
+            # Accept monitor / executor / analyzer / anomaly_detector in any
+            # underscore/camel spelling.
+            want = {s.strip().lower().replace("_", "") for s in substates.split(",")}
+            payload = {k: v for k, v in payload.items()
+                       if k.lower().replace("state", "") in want}
+        return 200, payload, {}
+
+    def _ep_kafka_cluster_state(self, q):
+        return 200, self.cc.kafka_cluster_state(), {}
+
+    def _ep_load(self, q):
+        def fn(progress):
+            progress.add_step("WaitingForClusterModel")
+            progress.add_step("GeneratingClusterModel")
+            return self.cc.broker_load()
+        return self._async("load", q, fn)
+
+    def _ep_partition_load(self, q):
+        max_entries = int(q.get("entries", "100"))
+        return 200, {"records": self.cc.partition_load(max_entries)}, {}
+
+    def _ep_proposals(self, q):
+        ignore_cache = _parse_bool(q, "ignore_proposal_cache", False)
+        goals = _parse_goals(q)
+
+        def fn(progress):
+            progress.add_step("GeneratingClusterModel")
+            progress.add_step("OptimizationProposalGeneration")
+            return self.cc.proposals(goals=goals, ignore_proposal_cache=ignore_cache)
+        return self._async("proposals", q, fn)
+
+    def _ep_user_tasks(self, q):
+        return 200, {"userTasks": self.user_tasks.list_tasks()}, {}
+
+    def _ep_review_board(self, q):
+        if self.purgatory is None:
+            return 400, {"error": "two-step verification is disabled"}, {}
+        return 200, {"requests": self.purgatory.board()}, {}
+
+    def _ep_bootstrap(self, q):
+        if self.sampler is None:
+            return 400, {"error": "no sampler configured for bootstrap"}, {}
+        start = int(q.get("start", "0"))
+        end = int(q.get("end", str(start + 1)))
+
+        def fn(progress):
+            progress.add_step("Bootstrapping")
+            n = self.cc.load_monitor.bootstrap(self.sampler, start, end)
+            return {"samplesLoaded": n}
+        return self._async("bootstrap", q, fn)
+
+    def _ep_train(self, q):
+        from cruise_control_tpu.model.cpu_model import CpuModelTrainer
+        from cruise_control_tpu.monitor.metricdef import KAFKA_METRIC_DEF
+
+        def fn(progress):
+            progress.add_step("Training")
+            trainer = CpuModelTrainer()
+            agg = self.cc.load_monitor.broker_aggregator.aggregate()
+            cpu = KAFKA_METRIC_DEF.metric_info("CPU_USAGE").metric_id
+            bin_ = KAFKA_METRIC_DEF.metric_info("LEADER_BYTES_IN").metric_id
+            bout = KAFKA_METRIC_DEF.metric_info("LEADER_BYTES_OUT").metric_id
+            rep = KAFKA_METRIC_DEF.metric_info("REPLICATION_BYTES_IN_RATE").metric_id
+            for row in range(agg.values.shape[0]):
+                for w in range(agg.values.shape[1]):
+                    if agg.window_valid[row, w]:
+                        v = agg.values[row, w]
+                        trainer.add_observation(v[bin_], v[bout], v[rep], v[cpu])
+            params = trainer.train()
+            return {"trained": params.trained, "numSamples": params.num_samples,
+                    "coefficients": {
+                        "leaderBytesIn": params.coef_leader_bytes_in,
+                        "leaderBytesOut": params.coef_leader_bytes_out,
+                        "followerBytesIn": params.coef_follower_bytes_in}}
+        return self._async("train", q, fn)
+
+    # -- POST endpoints ----------------------------------------------------
+    def _ep_rebalance(self, q):
+        dryrun = _parse_bool(q, "dryrun", True)
+        goals = _parse_goals(q)
+        dests = _parse_ids(q, "destination_broker_ids")
+
+        def fn(progress):
+            progress.add_step("GeneratingClusterModel")
+            progress.add_step("OptimizationForGoals")
+            return self.cc.rebalance(goals=goals, dryrun=dryrun,
+                                     destination_broker_ids=dests or None)
+        return self._async("rebalance", q, fn)
+
+    def _ep_add_broker(self, q):
+        ids = _parse_ids(q, "brokerid")
+        if not ids:
+            raise BadRequest("brokerid parameter is required")
+        dryrun = _parse_bool(q, "dryrun", True)
+
+        def fn(progress):
+            progress.add_step("OptimizationForGoals")
+            return self.cc.add_brokers(ids, dryrun=dryrun)
+        return self._async("add_broker", q, fn)
+
+    def _ep_remove_broker(self, q):
+        ids = _parse_ids(q, "brokerid")
+        if not ids:
+            raise BadRequest("brokerid parameter is required")
+        dryrun = _parse_bool(q, "dryrun", True)
+
+        def fn(progress):
+            progress.add_step("OptimizationForGoals")
+            ok = self.cc.remove_brokers(ids, dryrun=dryrun)
+            return {"ok": ok, "removedBrokers": ids, "dryrun": dryrun}
+        return self._async("remove_broker", q, fn)
+
+    def _ep_demote_broker(self, q):
+        ids = _parse_ids(q, "brokerid")
+        if not ids:
+            raise BadRequest("brokerid parameter is required")
+        dryrun = _parse_bool(q, "dryrun", True)
+
+        def fn(progress):
+            progress.add_step("OptimizationForGoals")
+            ok = self.cc.demote_brokers(ids, dryrun=dryrun)
+            return {"ok": ok, "demotedBrokers": ids, "dryrun": dryrun}
+        return self._async("demote_broker", q, fn)
+
+    def _ep_fix_offline_replicas(self, q):
+        dryrun = _parse_bool(q, "dryrun", True)
+
+        def fn(progress):
+            progress.add_step("OptimizationForGoals")
+            ok = self.cc.fix_offline_replicas(dryrun=dryrun)
+            return {"ok": ok, "dryrun": dryrun}
+        return self._async("fix_offline_replicas", q, fn)
+
+    def _ep_topic_configuration(self, q):
+        topic = q.get("topic")
+        rf = q.get("replication_factor")
+        if not topic or rf is None:
+            raise BadRequest("topic and replication_factor are required")
+        dryrun = _parse_bool(q, "dryrun", True)
+
+        def fn(progress):
+            progress.add_step("UpdatingTopicConfiguration")
+            ok = self.cc.update_topic_replication_factor({topic: int(rf)},
+                                                         dryrun=dryrun)
+            return {"ok": ok, "topic": topic, "replicationFactor": int(rf),
+                    "dryrun": dryrun}
+        return self._async("topic_configuration", q, fn)
+
+    def _ep_stop_proposal_execution(self, q):
+        force = _parse_bool(q, "force_stop", False)
+        self.cc.stop_proposal_execution(force=force)
+        return 200, {"message": "execution stop requested", "force": force}, {}
+
+    def _ep_pause_sampling(self, q):
+        self.cc.pause_sampling(reason=q.get("reason", ""))
+        return 200, {"message": "sampling paused"}, {}
+
+    def _ep_resume_sampling(self, q):
+        self.cc.resume_sampling()
+        return 200, {"message": "sampling resumed"}, {}
+
+    def _ep_admin(self, q):
+        """ADMIN endpoint (servlet AdminRequest): self-healing toggles,
+        concurrency changes, dropping recently-removed brokers."""
+        out: Dict[str, object] = {}
+        enable = q.get("enable_self_healing_for")
+        disable = q.get("disable_self_healing_for")
+        if (enable or disable) and self.detector_manager is None:
+            raise BadRequest("anomaly detector is not configured")
+        for raw, value in ((enable, True), (disable, False)):
+            if raw:
+                for name in raw.split(","):
+                    try:
+                        at = AnomalyType[name.strip().upper()]
+                    except KeyError as e:
+                        raise BadRequest(f"unknown anomaly type {name!r}") from e
+                    old = self.detector_manager.notifier.set_self_healing_for(at, value)
+                    out.setdefault("selfHealing", {})[at.name] = \
+                        {"before": old, "after": value}
+        conc = q.get("concurrent_partition_movements_per_broker")
+        if conc is not None:
+            limits = self.cc.executor._limits
+            limits = dataclasses.replace(limits, inter_broker_per_broker=int(conc))
+            self.cc.executor._limits = limits
+            out["interBrokerPartitionMovementConcurrency"] = int(conc)
+        drop = _parse_ids(q, "drop_recently_removed_brokers")
+        if drop:
+            self.cc.executor.drop_recently_removed_brokers(drop)
+            out["droppedRecentlyRemovedBrokers"] = drop
+        return 200, out or {"message": "no admin action requested"}, {}
+
+    def _ep_review(self, q):
+        if self.purgatory is None:
+            return 400, {"error": "two-step verification is disabled"}, {}
+        approve = tuple(_parse_ids(q, "approve"))
+        discard = tuple(_parse_ids(q, "discard"))
+        return 200, {"requests": self.purgatory.review(
+            approve, discard, q.get("reason", ""))}, {}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    api: CruiseControlApi = None  # injected by serve()
+
+    def _dispatch(self, method: str) -> None:
+        parsed = urllib.parse.urlparse(self.path)
+        if not parsed.path.startswith(PREFIX + "/"):
+            self._reply(404, {"error": f"paths live under {PREFIX}/"}, {})
+            return
+        endpoint = parsed.path[len(PREFIX) + 1:].strip("/")
+        query = {k: v[-1] for k, v in
+                 urllib.parse.parse_qs(parsed.query, keep_blank_values=True).items()}
+        status, body, headers = self.api.handle(method, endpoint, query,
+                                                dict(self.headers))
+        self._reply(status, body, headers)
+
+    def _reply(self, status: int, body: Dict, headers: Dict[str, str]) -> None:
+        payload = json.dumps(body, default=str).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        for k, v in headers.items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+        self._dispatch("GET")
+
+    def do_POST(self):  # noqa: N802
+        self._dispatch("POST")
+
+    def log_message(self, fmt, *args):  # NCSA-style access log, stderr
+        import sys
+        print(f"{self.address_string()} - [{self.log_date_time_string()}] "
+              f"{fmt % args}", file=sys.stderr)
+
+
+def serve(api: CruiseControlApi, host: str = "127.0.0.1", port: int = 9090
+          ) -> ThreadingHTTPServer:
+    """Start the HTTP server on a daemon thread; returns the server object
+    (KafkaCruiseControlApp.start analogue)."""
+    handler = type("BoundHandler", (_Handler,), {"api": api})
+    server = ThreadingHTTPServer((host, port), handler)
+    threading.Thread(target=server.serve_forever, daemon=True,
+                     name="cc-http-server").start()
+    return server

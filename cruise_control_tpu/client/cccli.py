@@ -1,0 +1,152 @@
+"""cccli — command-line client for the REST API.
+
+Parity with the reference's Python client
+(cruise-control-client/cruisecontrolclient/client/cccli.py: argparse-driven
+CLI, one subcommand per endpoint, long-poll progress display via
+User-Task-ID; Endpoint/Parameter model in client/Endpoint.py,
+Responder/Query session handling).  Pure stdlib (urllib).
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import sys
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, Optional, Tuple
+
+
+class CruiseControlClient:
+    """HTTP session + endpoint model (client/Responder.py analogue)."""
+
+    def __init__(self, base_url: str, auth: Optional[Tuple[str, str]] = None,
+                 timeout_s: float = 60.0):
+        self.base = base_url.rstrip("/")
+        if not self.base.endswith("/kafkacruisecontrol"):
+            self.base += "/kafkacruisecontrol"
+        self._auth = auth
+        self._timeout = timeout_s
+
+    def _request(self, method: str, endpoint: str,
+                 params: Dict[str, object]) -> Tuple[int, Dict, Dict[str, str]]:
+        qs = urllib.parse.urlencode({k: str(v) for k, v in params.items()
+                                     if v is not None})
+        url = f"{self.base}/{endpoint}" + (f"?{qs}" if qs else "")
+        req = urllib.request.Request(url, method=method)
+        if self._auth:
+            token = base64.b64encode(f"{self._auth[0]}:{self._auth[1]}".encode())
+            req.add_header("Authorization", f"Basic {token.decode()}")
+        try:
+            with urllib.request.urlopen(req, timeout=self._timeout) as resp:
+                return resp.status, json.loads(resp.read() or b"{}"), dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+    def call(self, method: str, endpoint: str, params: Dict[str, object],
+             poll: bool = True, poll_interval_s: float = 1.0,
+             progress=None) -> Tuple[int, Dict]:
+        """Issue the request; re-poll while it reports 202 (the client's
+        long-poll progress loop over User-Task-ID)."""
+        while True:
+            status, body, headers = self._request(method, endpoint, params)
+            if status != 202 or not poll:
+                return status, body
+            if progress:
+                progress(body)
+            if "reviewId" in body:  # parked in purgatory: nothing to poll
+                return status, body
+            time.sleep(poll_interval_s)
+
+
+def _print_progress(body: Dict) -> None:
+    steps = body.get("progress", [])
+    if steps:
+        last = steps[-1]
+        print(f"  … {last['step']} ({last['durationMs']} ms)", file=sys.stderr)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cccli", description="cruise-control-tpu command line client")
+    p.add_argument("-a", "--address", default="http://127.0.0.1:9090",
+                   help="server address (default %(default)s)")
+    p.add_argument("--user", help="basic-auth user")
+    p.add_argument("--password", help="basic-auth password")
+    p.add_argument("--no-poll", action="store_true",
+                   help="do not long-poll async operations")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    def add(name, method, help_, params=()):
+        sp = sub.add_parser(name, help=help_)
+        sp.set_defaults(_method=method, _endpoint=name)
+        for flag, kw in params:
+            sp.add_argument(flag, **kw)
+        return sp
+
+    add("state", "GET", "component states",
+        [("--substates", dict(help="comma list: monitor,executor,analyzer,anomaly_detector"))])
+    add("load", "GET", "per-broker load")
+    add("partition_load", "GET", "per-partition load",
+        [("--entries", dict(type=int, default=100))])
+    add("proposals", "GET", "optimization proposals",
+        [("--goals", dict(help="comma list of goal names")),
+         ("--ignore_proposal_cache", dict(action="store_true"))])
+    add("kafka_cluster_state", "GET", "partition/replica state")
+    add("user_tasks", "GET", "async task list")
+    add("review_board", "GET", "two-step review board")
+    add("bootstrap", "GET", "replay historical samples",
+        [("--start", dict(type=int, required=True)),
+         ("--end", dict(type=int, required=True))])
+    add("train", "GET", "train the CPU estimation model")
+
+    mut = [("--dryrun", dict(default="true", choices=["true", "false"])),
+           ("--review_id", dict(type=int))]
+    add("rebalance", "POST", "rebalance the cluster",
+        mut + [("--goals", dict()), ("--destination_broker_ids", dict())])
+    add("add_broker", "POST", "move load onto new brokers",
+        mut + [("--brokerid", dict(required=True))])
+    add("remove_broker", "POST", "decommission brokers",
+        mut + [("--brokerid", dict(required=True))])
+    add("demote_broker", "POST", "move leadership off brokers",
+        mut + [("--brokerid", dict(required=True))])
+    add("fix_offline_replicas", "POST", "heal offline replicas", mut)
+    add("topic_configuration", "POST", "change topic replication factor",
+        mut + [("--topic", dict(required=True)),
+               ("--replication_factor", dict(type=int, required=True))])
+    add("stop_proposal_execution", "POST", "stop the ongoing execution",
+        [("--force_stop", dict(action="store_true"))])
+    add("pause_sampling", "POST", "pause metric sampling",
+        [("--reason", dict(default=""))])
+    add("resume_sampling", "POST", "resume metric sampling")
+    add("admin", "POST", "admin actions",
+        [("--enable_self_healing_for", dict()),
+         ("--disable_self_healing_for", dict()),
+         ("--concurrent_partition_movements_per_broker", dict(type=int)),
+         ("--drop_recently_removed_brokers", dict())])
+    add("review", "POST", "approve/discard parked requests",
+        [("--approve", dict()), ("--discard", dict()),
+         ("--reason", dict(default=""))])
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    auth = (args.user, args.password) if args.user else None
+    client = CruiseControlClient(args.address, auth=auth)
+    params = {k: v for k, v in vars(args).items()
+              if not k.startswith("_") and k not in
+              ("address", "user", "password", "command", "no_poll")
+              and v is not None and v is not False}  # keep integer 0 values
+    params = {k: ("true" if v is True else v) for k, v in params.items()}
+    status, body = client.call(args._method, args._endpoint, params,
+                               poll=not args.no_poll, progress=_print_progress)
+    print(json.dumps(body, indent=2, default=str))
+    return 0 if status < 400 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

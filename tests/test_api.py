@@ -1,0 +1,217 @@
+"""API layer tests: facade operations, endpoint dispatch, user tasks,
+purgatory, security — and one real-HTTP round trip with the CLI client.
+"""
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.api.facade import CruiseControl
+from cruise_control_tpu.api.server import (BasicSecurityProvider, CruiseControlApi,
+                                           GET_ENDPOINTS, POST_ENDPOINTS, serve)
+from cruise_control_tpu.detector.manager import AnomalyDetectorManager
+from cruise_control_tpu.detector.notifier import SelfHealingNotifier
+from cruise_control_tpu.executor.admin import InMemoryClusterAdmin
+from cruise_control_tpu.executor.executor import Executor
+from cruise_control_tpu.monitor.capacity import StaticCapacityResolver
+from cruise_control_tpu.monitor.load_monitor import LoadMonitor
+from cruise_control_tpu.monitor.metadata import (BrokerInfo, ClusterMetadata,
+                                                 MetadataClient, PartitionInfo)
+from cruise_control_tpu.monitor.sampling import SyntheticWorkloadSampler
+
+W = 300_000
+
+
+def build_stack(num_brokers=5, two_step=False, security=None):
+    rng = np.random.default_rng(19)
+    brokers = tuple(BrokerInfo(i, rack=f"r{i % 3}", host=f"h{i}")
+                    for i in range(num_brokers))
+    w = np.linspace(1, 4, num_brokers)
+    w /= w.sum()
+    parts = []
+    for t in range(3):
+        for p in range(8):
+            reps = tuple(int(x) for x in
+                         rng.choice(num_brokers, 2, replace=False, p=w))
+            parts.append(PartitionInfo(f"t{t}", p, leader=reps[0], replicas=reps))
+    mc = MetadataClient(ClusterMetadata(brokers=brokers, partitions=tuple(parts)))
+    lm = LoadMonitor(mc, StaticCapacityResolver(), num_partition_windows=3,
+                     partition_window_ms=W)
+    lm.start_up()
+    sampler = SyntheticWorkloadSampler()
+    for wdx in range(4):
+        lm.fetch_once(sampler, wdx * W, wdx * W + 1)
+    admin = InMemoryClusterAdmin(mc, latency_polls=1)
+    ex = Executor(admin, mc)
+    cc = CruiseControl(lm, ex, admin,
+                       goals=["RackAwareGoal", "DiskCapacityGoal",
+                              "ReplicaDistributionGoal",
+                              "LeaderReplicaDistributionGoal"],
+                       hard_goals=["RackAwareGoal", "DiskCapacityGoal"])
+    mgr = AnomalyDetectorManager(SelfHealingNotifier(), cc,
+                                 executor_busy=lambda: ex.has_ongoing_execution)
+    api = CruiseControlApi(cc, detector_manager=mgr, sampler=sampler,
+                           two_step_verification=two_step, security=security)
+    return api, cc, mc
+
+
+def test_endpoint_inventory():
+    # The reference exposes exactly 20 endpoints (CruiseControlEndPoint.java).
+    assert len(GET_ENDPOINTS) + len(POST_ENDPOINTS) == 20
+
+
+def test_state_endpoint():
+    api, _, _ = build_stack()
+    status, body, _ = api.handle("GET", "state", {})
+    assert status == 200
+    assert body["MonitorState"]["validWindows"] == 3
+    assert body["ExecutorState"]["state"] == "no_task_in_progress"
+    assert "AnomalyDetectorState" in body
+    status, body, _ = api.handle("GET", "state", {"substates": "monitor"})
+    assert "MonitorState" in body and "ExecutorState" not in body
+
+
+def test_unknown_endpoint_and_bad_params():
+    api, _, _ = build_stack()
+    status, body, _ = api.handle("GET", "nope", {})
+    assert status == 404 and "validEndpoints" in body
+    status, body, _ = api.handle("POST", "rebalance", {"dryrun": "maybe"})
+    assert status == 400 and "dryrun" in body["error"]
+    status, body, _ = api.handle("POST", "add_broker", {})
+    assert status == 400
+
+
+def test_proposals_cached_then_invalidated():
+    api, cc, _ = build_stack()
+    s1, b1, _ = api.handle("GET", "proposals", {"max_wait_s": "300"})
+    assert s1 == 200 and b1["reason"] != "cached"
+    s2, b2, _ = api.handle("GET", "proposals", {"_": "2", "max_wait_s": "300"})
+    assert s2 == 200 and b2["reason"] == "cached"
+    cc.invalidate_proposal_cache()
+    s3, b3, _ = api.handle("GET", "proposals", {"_": "3", "max_wait_s": "300"})
+    assert b3["reason"] != "cached"
+
+
+def test_rebalance_dryrun_then_execute():
+    api, cc, mc = build_stack()
+    s, dry, _ = api.handle("POST", "rebalance", {"max_wait_s": "300"})
+    assert s == 200 and dry["dryrun"] and dry["numProposals"] > 0
+    before = {p.tp: p.replicas for p in mc.cluster().partitions}
+    s, wet, _ = api.handle("POST", "rebalance", {"dryrun": "false", "max_wait_s": "300"})
+    assert s == 200 and wet["ok"] and wet["execution"]["completed"] > 0
+    after = {p.tp: p.replicas for p in mc.cluster().partitions}
+    assert before != after  # cluster actually mutated
+
+
+def test_remove_broker_via_api():
+    api, cc, mc = build_stack()
+    s, body, _ = api.handle("POST", "remove_broker",
+                            {"brokerid": "4", "dryrun": "false", "max_wait_s": "300"})
+    assert s == 200 and body["ok"]
+    assert not any(4 in p.replicas for p in mc.cluster().partitions)
+    assert 4 in cc.executor.recently_removed_brokers()
+
+
+def test_topic_configuration_rf_change():
+    api, cc, mc = build_stack()
+    s, body, _ = api.handle("POST", "topic_configuration",
+                            {"topic": "t0", "replication_factor": "3",
+                             "dryrun": "false", "max_wait_s": "300"})
+    assert s == 200 and body["ok"]
+    for p in mc.cluster().partitions:
+        if p.topic == "t0":
+            assert len(p.replicas) == 3
+            assert len(set(p.replicas)) == 3
+
+
+def test_user_tasks_listed():
+    api, _, _ = build_stack()
+    api.handle("GET", "load", {})
+    s, body, _ = api.handle("GET", "user_tasks", {})
+    assert s == 200
+    assert any(t["RequestURL"] == "load" for t in body["userTasks"])
+    assert all(t["Status"] in ("Active", "Completed") for t in body["userTasks"])
+
+
+def test_purgatory_two_step_flow():
+    api, _, mc = build_stack(two_step=True)
+    s, parked, _ = api.handle("POST", "rebalance", {"dryrun": "false"})
+    assert s == 202 and parked["status"] == "PENDING_REVIEW"
+    rid = parked["reviewId"]
+    # Direct re-submit without approval fails.
+    s, body, _ = api.handle("POST", "rebalance", {"review_id": str(rid)})
+    assert s == 400
+    # Approve then resubmit.
+    s, body, _ = api.handle("POST", "review", {"approve": str(rid)})
+    assert s == 200
+    s, body, _ = api.handle("GET", "review_board", {})
+    assert body["requests"][0]["Status"] == "APPROVED"
+    s, body, _ = api.handle("POST", "rebalance",
+                            {"review_id": str(rid), "max_wait_s": "300"})
+    assert s == 200 and body["ok"]
+    executed = body
+    # Re-polling a submitted review returns the SAME task's result — it is
+    # executed exactly once, and override params at resubmit are ignored.
+    s, body, _ = api.handle("POST", "rebalance",
+                            {"review_id": str(rid), "dryrun": "true"})
+    assert s == 200 and body == executed
+    # An unknown review id still fails.
+    s, body, _ = api.handle("POST", "rebalance", {"review_id": "999"})
+    assert s == 400
+
+
+def test_basic_security_roles():
+    import base64
+
+    def hdr(user, pw):
+        return {"Authorization":
+                "Basic " + base64.b64encode(f"{user}:{pw}".encode()).decode()}
+    sec = BasicSecurityProvider({"viewer": ("v", "VIEWER"),
+                                 "admin": ("a", "ADMIN")})
+    api, _, _ = build_stack(security=sec)
+    assert api.handle("GET", "state", {}, {})[0] == 401
+    assert api.handle("GET", "state", {}, hdr("viewer", "wrong"))[0] == 401
+    assert api.handle("GET", "state", {}, hdr("viewer", "v"))[0] == 200
+    assert api.handle("POST", "rebalance", {}, hdr("viewer", "v"))[0] == 403
+    assert api.handle("GET", "user_tasks", {}, hdr("viewer", "v"))[0] == 403
+    assert api.handle("POST", "pause_sampling", {}, hdr("admin", "a"))[0] == 200
+
+
+def test_admin_endpoint():
+    api, cc, _ = build_stack()
+    s, body, _ = api.handle("POST", "admin",
+                            {"enable_self_healing_for": "broker_failure",
+                             "concurrent_partition_movements_per_broker": "5"})
+    assert s == 200
+    assert body["selfHealing"]["BROKER_FAILURE"]["after"] is True
+    assert cc.executor._limits.inter_broker_per_broker == 5
+
+
+def test_http_server_and_cli_client_roundtrip():
+    api, _, _ = build_stack()
+    server = serve(api, host="127.0.0.1", port=0)
+    port = server.server_address[1]
+    try:
+        from cruise_control_tpu.client.cccli import CruiseControlClient, main
+        client = CruiseControlClient(f"http://127.0.0.1:{port}")
+        status, body = client.call("GET", "state", {})
+        assert status == 200 and "MonitorState" in body
+        status, body = client.call("POST", "rebalance",
+                                   {"dryrun": "true", "max_wait_s": "300"})
+        assert status == 200 and body["numProposals"] >= 0
+        # CLI main() end-to-end.
+        rc = main(["-a", f"http://127.0.0.1:{port}", "state"])
+        assert rc == 0
+        rc = main(["-a", f"http://127.0.0.1:{port}", "proposals"])
+        assert rc == 0
+    finally:
+        server.shutdown()
+
+
+def test_train_endpoint():
+    api, _, _ = build_stack()
+    s, body, _ = api.handle("GET", "train", {})
+    assert s == 200 and body["trained"]
