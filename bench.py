@@ -23,11 +23,11 @@ import time
 
 
 SCALES = {
-    # name: (brokers, racks, topics, mean parts/topic, rf)
-    "small": (3, 3, 5, 20.0, 3),       # ~300 partitions ladder rung
-    "mid": (50, 10, 40, 42.0, 3),      # ~50-broker / 10k-replica rung
-    "large": (200, 20, 100, 111.0, 3),  # ~200-broker / 100k-replica rung
-    "xl": (1000, 40, 200, 278.0, 3),   # stretch rung toward 7k/1M
+    # name: (brokers, racks, topics, mean parts/topic, rf) — parts × rf ≈ replicas
+    "small": (3, 3, 5, 20.0, 3),        # ~300-replica ladder rung
+    "mid": (50, 10, 40, 84.0, 3),       # ~50-broker / 10k-replica rung
+    "large": (200, 20, 100, 333.0, 3),  # ~200-broker / 100k-replica rung
+    "xl": (1000, 40, 200, 1667.0, 3),   # stretch rung toward 7k/1M
 }
 
 STACK = [

@@ -100,7 +100,9 @@ def diff(initial: TensorClusterModel, final: TensorClusterModel) -> List[Executi
 
     Replica-list order follows the reference's convention: the (new) leader
     first, then the remaining replicas in partition-table order — the order
-    Kafka receives in the reassignment request.
+    Kafka receives in the reassignment request.  The comparison walks the
+    partition table in C++ when the native library is available (the
+    1M-replica fast path); the Python path below is the fallback and oracle.
     """
     pr0, rb0, rd0, lead0, valid0 = _partition_placements(initial)
     pr1, rb1, rd1, lead1, valid1 = _partition_placements(final)
@@ -110,6 +112,28 @@ def diff(initial: TensorClusterModel, final: TensorClusterModel) -> List[Executi
     load = np.asarray(initial.replica_load())
     ptopic = np.asarray(initial.partition_topic)
     from cruise_control_tpu.common.resources import Resource
+
+    from cruise_control_tpu import native
+    nat = native.diff_partitions(pr0, rb0, rb1, rd0, rd1, lead0, lead1)
+    if nat is not None:
+        changed_ids, ob, nb, od, nd = nat
+        pvalid = np.asarray(initial.partition_valid)
+        proposals: List[ExecutionProposal] = []
+        for i, p in enumerate(changed_ids):
+            if not pvalid[p]:
+                continue
+            slots = pr0[p][pr0[p] >= 0]
+            old = tuple(ReplicaPlacement(int(b), int(d))
+                        for b, d in zip(ob[i], od[i]) if b >= 0)
+            new = tuple(ReplicaPlacement(int(b), int(d))
+                        for b, d in zip(nb[i], nd[i]) if b >= 0)
+            if old == new:
+                continue
+            size = float(load[slots, Resource.DISK].max())
+            proposals.append(ExecutionProposal(
+                partition=int(p), topic=int(ptopic[p]), partition_size=size,
+                old_leader=old[0], old_replicas=old, new_replicas=new))
+        return proposals
 
     # Vectorized prefilter: only partitions with any change produce objects.
     sl = pr0 >= 0

@@ -413,15 +413,13 @@ def build_model(
     broker_valid = np.zeros(Bp, bool)
     broker_valid[:B] = True
 
-    # Build the partition→replica-ids table (static membership).
+    # Build the partition→replica-ids table (static membership); native
+    # kernel at scale, numpy fallback inside.
+    from cruise_control_tpu import native
     rf_counts = np.bincount(replica_partition, minlength=P)
     max_rf = int(rf_counts.max()) if R else 1
-    partition_replicas = np.full((P, max_rf), -1, np.int32)
-    slot = np.zeros(P, np.int64)
-    for i in range(R):
-        p = replica_partition[i]
-        partition_replicas[p, slot[p]] = i
-        slot[p] += 1
+    partition_replicas = native.build_partition_replicas(
+        replica_partition.astype(np.int32), P, max_rf)
 
     model = TensorClusterModel(
         replica_broker=jnp.asarray(pad(replica_broker.astype(np.int32), Rp)),

@@ -202,6 +202,51 @@ class MetricSampleAggregator:
             self._generation += 1
             return True
 
+    def add_samples(self, samples) -> int:
+        """Batched ingestion of (entity, time_ms, {metric: value}) triples —
+        the warm-start / bootstrap hot path.  Uses the native ingest kernel
+        when available; otherwise falls back to per-sample ``add_sample``.
+        Returns the number of accepted samples."""
+        from cruise_control_tpu import native
+        if not samples:
+            return 0
+        with self._lock:
+            max_window = max(t // self._window_ms for _, t, _ in samples)
+            if max_window > self._current_window_index:
+                self._roll_to(max_window)
+            rows, slots, times = [], [], []
+            vals = np.zeros((len(samples), self._m), np.float64)
+            mask = np.zeros((len(samples), self._m), np.uint8)
+            n = 0
+            for entity, time_ms, values in samples:
+                window_index = time_ms // self._window_ms
+                if window_index < self._oldest_window_index:
+                    continue
+                rows.append(self._row(entity))
+                slots.append(self._slot(window_index))
+                times.append(time_ms)
+                for name, val in values.items():
+                    mid = self._metric_def.metric_info(name).metric_id
+                    vals[n, mid] = val
+                    mask[n, mid] = 1
+                n += 1
+            if n == 0:
+                return 0
+            ok = native.ingest_samples(
+                self._sum, self._max, self._latest_val, self._latest_ts,
+                self._count,
+                np.asarray(rows, np.int64), np.asarray(slots, np.int64),
+                np.asarray(times, np.int64), vals[:n], mask[:n])
+            self._generation += 1
+            if ok:
+                return n
+        # Native unavailable: per-sample path (re-acquires the lock inside).
+        accepted = 0
+        for entity, time_ms, values in samples:
+            if self.add_sample(entity, time_ms, values):
+                accepted += 1
+        return accepted
+
     # -- aggregation -------------------------------------------------------
     def _completed_order(self) -> np.ndarray:
         """Slot indices of completed windows, oldest → newest."""

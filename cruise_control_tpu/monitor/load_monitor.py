@@ -174,13 +174,10 @@ class LoadMonitor:
         return total
 
     def _ingest(self, samples: Samples, persist: bool) -> int:
-        n = 0
-        for ps in samples.partition_samples:
-            if self.partition_aggregator.add_sample(ps.entity, ps.time_ms, ps.metrics):
-                n += 1
-        for bs in samples.broker_samples:
-            if self.broker_aggregator.add_sample(bs.entity, bs.time_ms, bs.metrics):
-                n += 1
+        n = self.partition_aggregator.add_samples(
+            [(ps.entity, ps.time_ms, ps.metrics) for ps in samples.partition_samples])
+        n += self.broker_aggregator.add_samples(
+            [(bs.entity, bs.time_ms, bs.metrics) for bs in samples.broker_samples])
         if persist and n:
             self._store.store_samples(samples)
         return n

@@ -1,0 +1,142 @@
+"""ctypes bindings for the native kernels, with build-on-first-use.
+
+pybind11 is not available in this environment; the C++ side exposes a plain
+C ABI (cc_native.cpp) and is compiled once with g++ into a cached shared
+library.  Every entry point has a pure-Python/numpy fallback — ``available()``
+reports whether the native path loaded, and callers fall back transparently.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "cc_native.cpp")
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+
+
+def _build_dir() -> str:
+    d = os.environ.get("CC_TPU_NATIVE_CACHE") or os.path.join(
+        os.path.expanduser("~"), ".cache", "cruise_control_tpu")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        _TRIED = True
+        try:
+            with open(_SRC, "rb") as f:
+                digest = hashlib.sha256(f.read()).hexdigest()[:16]
+            so = os.path.join(_build_dir(), f"cc_native-{digest}.so")
+            if not os.path.exists(so):
+                tmp = so + ".tmp"
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                     "-o", tmp, _SRC],
+                    check=True, capture_output=True)
+                os.replace(tmp, so)
+            lib = ctypes.CDLL(so)
+            lib.build_partition_replicas.restype = ctypes.c_int32
+            lib.build_partition_replicas.argtypes = [
+                _i32p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                _i32p, _i32p]
+            lib.diff_partitions.restype = ctypes.c_int64
+            lib.diff_partitions.argtypes = [
+                _i32p, ctypes.c_int64, ctypes.c_int64,
+                _i32p, _i32p, _i32p, _i32p, _u8p, _u8p,
+                _i32p, _i32p, _i32p, _i32p, _i32p]
+            lib.ingest_samples.restype = None
+            lib.ingest_samples.argtypes = [
+                _f64p, _f64p, _f64p, _i64p, _i64p,
+                ctypes.c_int64, ctypes.c_int64,
+                _i64p, _i64p, _i64p, _f64p, _u8p, ctypes.c_int64]
+            _LIB = lib
+        except (OSError, subprocess.CalledProcessError):
+            _LIB = None
+        return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def build_partition_replicas(replica_partition: np.ndarray, num_partitions: int,
+                             max_rf: int) -> np.ndarray:
+    """[P, max_rf] replica-id table (-1 pad); native with numpy fallback."""
+    r = int(replica_partition.shape[0])
+    lib = _load()
+    if lib is not None and r:
+        out = np.full((num_partitions, max_rf), -1, np.int32)
+        scratch = np.zeros(num_partitions, np.int32)
+        rp = np.ascontiguousarray(replica_partition, np.int32)
+        rc = lib.build_partition_replicas(rp, r, num_partitions, max_rf, out, scratch)
+        if rc >= 0:
+            return out
+    out = np.full((num_partitions, max_rf), -1, np.int32)
+    slot = np.zeros(num_partitions, np.int64)
+    for i in range(r):
+        p = replica_partition[i]
+        out[p, slot[p]] = i
+        slot[p] += 1
+    return out
+
+
+def diff_partitions(partition_replicas: np.ndarray,
+                    rb0, rb1, rd0, rd1, ld0, ld1):
+    """Native proposal diff.  Returns (changed_part_ids, old_brokers,
+    new_brokers, old_disks, new_disks) trimmed to the changed rows, or None
+    when the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    p, max_rf = partition_replicas.shape
+    pr = np.ascontiguousarray(partition_replicas, np.int32)
+    changed = np.empty(p, np.int32)
+    ob = np.empty((p, max_rf), np.int32)
+    nb = np.empty((p, max_rf), np.int32)
+    od = np.empty((p, max_rf), np.int32)
+    nd = np.empty((p, max_rf), np.int32)
+    n = lib.diff_partitions(
+        pr, p, max_rf,
+        np.ascontiguousarray(rb0, np.int32), np.ascontiguousarray(rb1, np.int32),
+        np.ascontiguousarray(rd0, np.int32), np.ascontiguousarray(rd1, np.int32),
+        np.ascontiguousarray(ld0, np.uint8), np.ascontiguousarray(ld1, np.uint8),
+        changed, ob, nb, od, nd)
+    return changed[:n].copy(), ob[:n].copy(), nb[:n].copy(), od[:n].copy(), nd[:n].copy()
+
+
+def ingest_samples(sum_arr, max_arr, latest_arr, latest_ts, count,
+                   rows, slots, times_ms, values, value_mask) -> bool:
+    """Batched aggregator ingestion; returns False if native is unavailable
+    (caller then takes the per-sample Python path)."""
+    lib = _load()
+    if lib is None:
+        return False
+    cap, w1, m = sum_arr.shape
+    lib.ingest_samples(
+        sum_arr.reshape(-1), max_arr.reshape(-1), latest_arr.reshape(-1),
+        latest_ts.reshape(-1), count.reshape(-1), w1, m,
+        np.ascontiguousarray(rows, np.int64), np.ascontiguousarray(slots, np.int64),
+        np.ascontiguousarray(times_ms, np.int64),
+        np.ascontiguousarray(values, np.float64),
+        np.ascontiguousarray(value_mask, np.uint8),
+        int(rows.shape[0]))
+    return True

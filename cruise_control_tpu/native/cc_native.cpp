@@ -1,0 +1,137 @@
+// Native kernels for the host-bound hot paths (SURVEY.md §7 item 7).
+//
+// The TPU owns candidate scoring; these cover the CPU-side work that scales
+// with the replica axis and is Python-loop-bound at the 1M-replica ladder
+// rung (the reference's "native obligation" attaches to the optimizer core
+// rather than ported code — there is no native code anywhere in the
+// reference, SURVEY.md "Languages"):
+//
+//   1. build_partition_replicas — the partition → replica-id table that
+//      model construction needs (tensor_model.build_model), O(R).
+//   2. diff_partitions — the proposal diff over initial vs final
+//      placements (analyzer/proposals.diff; AnalyzerUtils.getDiff
+//      analogue), O(P · max_rf).
+//   3. ingest_samples — batched aggregator ingestion (sum/max/latest/count
+//      ring-buffer update; aggregator/RawMetricValues addSample hot loop),
+//      O(samples · metrics).
+//
+// Plain C ABI (ctypes binding — pybind11 is not available in this image).
+// All buffers are caller-allocated numpy arrays; no allocation happens here.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// 1. partition→replica table.  out[P * max_rf] pre-filled with -1.
+//    Returns max replication factor actually seen (≤ max_rf), or -1 if a
+//    partition exceeds max_rf slots.
+int32_t build_partition_replicas(const int32_t* replica_partition, int64_t num_replicas,
+                                 int64_t num_partitions, int64_t max_rf,
+                                 int32_t* out, int32_t* slot_scratch /* P zeros */) {
+    int32_t seen_rf = 0;
+    for (int64_t i = 0; i < num_replicas; ++i) {
+        int32_t p = replica_partition[i];
+        if (p < 0 || p >= num_partitions) return -1;
+        int32_t s = slot_scratch[p]++;
+        if (s >= max_rf) return -1;
+        out[(int64_t)p * max_rf + s] = (int32_t)i;
+        if (s + 1 > seen_rf) seen_rf = s + 1;
+    }
+    return seen_rf;
+}
+
+// 2. Proposal diff.  For each partition, compare (broker, disk, leader) of
+//    its replicas between the initial and final model and emit the changed
+//    partitions with ordered (leader-first) old/new broker+disk lists.
+//
+//    partition_replicas: [P, max_rf] replica ids (-1 pad), initial table.
+//    rb0/rb1: replica→broker, rd0/rd1: replica→disk, ld0/ld1: leader flags.
+//    Outputs (capacity P rows): changed_parts[P],
+//    old_brokers/new_brokers/old_disks/new_disks: [P, max_rf] (-1 pad).
+//    Returns the number of changed partitions.
+int64_t diff_partitions(const int32_t* partition_replicas, int64_t num_partitions,
+                        int64_t max_rf,
+                        const int32_t* rb0, const int32_t* rb1,
+                        const int32_t* rd0, const int32_t* rd1,
+                        const uint8_t* ld0, const uint8_t* ld1,
+                        int32_t* changed_parts,
+                        int32_t* old_brokers, int32_t* new_brokers,
+                        int32_t* old_disks, int32_t* new_disks) {
+    int64_t n_changed = 0;
+    for (int64_t p = 0; p < num_partitions; ++p) {
+        const int32_t* slots = partition_replicas + p * max_rf;
+        bool changed = false;
+        for (int64_t s = 0; s < max_rf; ++s) {
+            int32_t r = slots[s];
+            if (r < 0) break;
+            if (rb0[r] != rb1[r] || rd0[r] != rd1[r] || ld0[r] != ld1[r]) {
+                changed = true;
+                break;
+            }
+        }
+        if (!changed) continue;
+        // Emit ordered lists: leader first, then table order.
+        int32_t* ob = old_brokers + n_changed * max_rf;
+        int32_t* nb = new_brokers + n_changed * max_rf;
+        int32_t* od = old_disks + n_changed * max_rf;
+        int32_t* nd = new_disks + n_changed * max_rf;
+        for (int64_t s = 0; s < max_rf; ++s) { ob[s] = nb[s] = od[s] = nd[s] = -1; }
+        int64_t rf = 0;
+        for (int64_t s = 0; s < max_rf; ++s) {
+            if (slots[s] < 0) break;
+            ++rf;
+        }
+        // old ordering
+        int64_t lead_pos = 0;
+        for (int64_t s = 0; s < rf; ++s) if (ld0[slots[s]]) { lead_pos = s; break; }
+        int64_t w = 0;
+        ob[w] = rb0[slots[lead_pos]]; od[w] = rd0[slots[lead_pos]]; ++w;
+        for (int64_t s = 0; s < rf; ++s) {
+            if (s == lead_pos) continue;
+            ob[w] = rb0[slots[s]]; od[w] = rd0[slots[s]]; ++w;
+        }
+        // new ordering
+        lead_pos = 0;
+        for (int64_t s = 0; s < rf; ++s) if (ld1[slots[s]]) { lead_pos = s; break; }
+        w = 0;
+        nb[w] = rb1[slots[lead_pos]]; nd[w] = rd1[slots[lead_pos]]; ++w;
+        for (int64_t s = 0; s < rf; ++s) {
+            if (s == lead_pos) continue;
+            nb[w] = rb1[slots[s]]; nd[w] = rd1[slots[s]]; ++w;
+        }
+        changed_parts[n_changed++] = (int32_t)p;
+    }
+    return n_changed;
+}
+
+// 3. Batched sample ingestion into the aggregator ring buffers.
+//    Arrays are the aggregator's [cap, W+1, M] (sum/max/latest) and
+//    [cap, W+1] (count, latest_ts) tensors, flattened C-order.  Each sample
+//    i carries row, slot, time_ms and M metric values with a validity mask.
+void ingest_samples(double* sum, double* maxv, double* latest, int64_t* latest_ts,
+                    int64_t* count,
+                    int64_t w1, int64_t m,
+                    const int64_t* rows, const int64_t* slots,
+                    const int64_t* times_ms,
+                    const double* values,      // [n, m]
+                    const uint8_t* value_mask, // [n, m]
+                    int64_t n) {
+    for (int64_t i = 0; i < n; ++i) {
+        int64_t base2 = rows[i] * w1 + slots[i];
+        int64_t base3 = base2 * m;
+        const double* v = values + i * m;
+        const uint8_t* msk = value_mask + i * m;
+        bool newest = times_ms[i] >= latest_ts[base2];
+        for (int64_t j = 0; j < m; ++j) {
+            if (!msk[j]) continue;
+            sum[base3 + j] += v[j];
+            if (v[j] > maxv[base3 + j]) maxv[base3 + j] = v[j];
+            if (newest) latest[base3 + j] = v[j];
+        }
+        if (newest) latest_ts[base2] = times_ms[i];
+        count[base2] += 1;
+    }
+}
+
+}  // extern "C"

@@ -1,0 +1,78 @@
+"""Native kernel tests: availability, parity with the Python oracles, and
+scale smoke (the C++ fast paths of SURVEY.md §7 item 7).
+"""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu import native
+from cruise_control_tpu.analyzer import optimizer as opt, proposals as props
+from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster
+from cruise_control_tpu.monitor.aggregator import MetricSampleAggregator
+
+W = 300_000
+
+
+def test_native_library_builds():
+    # g++ is part of the image; the native path must actually load here.
+    assert native.available()
+
+
+def test_partition_table_parity():
+    rng = np.random.default_rng(0)
+    parts = np.repeat(np.arange(500, dtype=np.int32), 3)
+    rng.shuffle(parts)
+    table = native.build_partition_replicas(parts, 500, 3)
+    # Oracle: every replica appears exactly once in its partition's row.
+    for i, p in enumerate(parts):
+        assert i in table[p]
+    assert (table >= 0).sum() == parts.shape[0]
+
+
+def test_diff_parity_native_vs_python(monkeypatch):
+    model = generate_cluster(ClusterSpec(num_brokers=6, num_racks=3, seed=44,
+                                         distribution="exponential"))
+    run = opt.optimize(model, ["ReplicaDistributionGoal",
+                               "LeaderReplicaDistributionGoal"],
+                       raise_on_hard_failure=False)
+    nat = props.diff(model, run.model)
+    monkeypatch.setattr(native, "diff_partitions", lambda *a, **k: None)
+    py = props.diff(model, run.model)
+    assert len(nat) == len(py)
+    for a, b in zip(sorted(nat, key=lambda p: p.partition),
+                    sorted(py, key=lambda p: p.partition)):
+        assert a == b
+
+
+def test_batch_ingest_parity():
+    samples = []
+    rng = np.random.default_rng(1)
+    for e in range(40):
+        for w in range(4):
+            for k in range(3):
+                samples.append((f"e{e}", w * W + k,
+                                {"CPU_USAGE": float(rng.random()),
+                                 "DISK_USAGE": float(rng.random()) * 100}))
+    a1 = MetricSampleAggregator(3, W)
+    assert a1.add_samples(samples) == len(samples)
+    a2 = MetricSampleAggregator(3, W)
+    for e, t, v in samples:
+        a2.add_sample(e, t, v)
+    r1, r2 = a1.aggregate(), a2.aggregate()
+    np.testing.assert_allclose(r1.collapsed, r2.collapsed, rtol=1e-12)
+    np.testing.assert_array_equal(r1.entity_valid, r2.entity_valid)
+    np.testing.assert_array_equal(r1.extrapolations, r2.extrapolations)
+
+
+def test_scale_smoke_100k_replicas():
+    import time
+    t0 = time.monotonic()
+    model = generate_cluster(ClusterSpec(num_brokers=200, num_racks=20,
+                                         num_topics=50,
+                                         mean_partitions_per_topic=350.0,
+                                         replication_factor=3, seed=9))
+    build_s = time.monotonic() - t0
+    r = int(np.asarray(model.replica_valid).sum())
+    assert r > 50_000
+    # Model build at 100k replicas must be seconds, not minutes.
+    assert build_s < 30, f"build took {build_s:.1f}s"
