@@ -32,14 +32,17 @@ _NEG = -1e29  # "irrelevant" sentinel threshold (relevance uses -1e30)
 
 
 def default_num_sources(model: TensorClusterModel) -> int:
-    """Top-S source replicas per step: wide enough to feed one action per
-    broker pair, capped so the candidate batch stays MXU-friendly, and never
-    wider than the replica axis (top_k requires k <= length)."""
-    return max(1, min(model.num_replicas_padded, max(8, min(4 * model.num_brokers, 512))))
+    """Top-S source replicas per step: wide enough that every broker can shed
+    several replicas per step (the K = S·D batch should be 10^5-ish at the
+    50-broker rung, not 10^3 — steps are device-resident so per-step compute,
+    not dispatch count, is the budget), capped so the batch stays in HBM
+    comfortably, and never wider than the replica axis (top_k needs k ≤ R)."""
+    want = max(64, 8 * model.num_brokers)
+    return max(1, min(model.num_replicas_padded, min(want, 4096)))
 
 
 def default_num_dests(model: TensorClusterModel) -> int:
-    return max(1, min(model.num_brokers, 32))
+    return max(1, min(model.num_brokers, 64))
 
 
 def move_candidates(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,

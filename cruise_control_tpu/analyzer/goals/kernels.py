@@ -33,7 +33,7 @@ from cruise_control_tpu.analyzer.balancing_constraint import BALANCE_MARGIN, Bal
 from cruise_control_tpu.analyzer.goals.specs import GoalSpec
 from cruise_control_tpu.analyzer.state import BrokerArrays
 from cruise_control_tpu.common.resources import Resource
-from cruise_control_tpu.model.tensor_model import TensorClusterModel
+from cruise_control_tpu.model.tensor_model import BrokerState, TensorClusterModel
 
 _BIG = 1e30
 _OFFLINE_BONUS = 1e12  # healing moves (offline replicas off dead brokers) dominate
@@ -101,13 +101,21 @@ def broker_metric(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArray
 
 def _wrong_leader_mask(model: TensorClusterModel) -> Array:
     """bool[R] — replica currently leads a partition whose preferred replica
-    is a different, online replica."""
+    is a different, online, non-demoted replica; OR leads from a DEMOTED
+    broker (the demote path: DemoteBrokerRunnable runs
+    PreferredLeaderElectionGoal to force ALL leadership off demoted brokers,
+    handler/async/runnable/DemoteBrokerRunnable.java)."""
     preferred = model.preferred_leader_replica()[model.replica_partition]
     r_idx = jnp.arange(model.num_replicas_padded, dtype=jnp.int32)
-    pref_ok = model.replica_valid[jnp.maximum(preferred, 0)] & \
-        ~model.replica_offline_now()[jnp.maximum(preferred, 0)] & (preferred >= 0)
+    safe_pref = jnp.maximum(preferred, 0)
+    pref_broker = model.replica_broker[safe_pref]
+    pref_ok = (model.replica_valid[safe_pref]
+               & ~model.replica_offline_now()[safe_pref]
+               & (model.broker_state[pref_broker] != BrokerState.DEMOTED)
+               & (preferred >= 0))
+    on_demoted = model.broker_state[model.replica_broker] == BrokerState.DEMOTED
     return (model.replica_is_leader & model.replica_valid
-            & (preferred != r_idx) & pref_ok)
+            & (((preferred != r_idx) & pref_ok) | on_demoted))
 
 
 def _designated_topic_mask(model: TensorClusterModel,
@@ -332,10 +340,15 @@ def self_feasible(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArray
     kind = spec.kind
     unhealthy = _src_unhealthy(model, cand, arrays)
     if kind == "preferred_leader":
-        # Only leadership transfers to the partition's preferred replica.
+        # Leadership transfers to the partition's preferred replica — or,
+        # when the source broker is DEMOTED, to ANY eligible non-demoted
+        # sibling (the candidate generator already excludes demoted/dead/
+        # excluded destinations).
         preferred = model.preferred_leader_replica()[cand.partition]
         wrong = _wrong_leader_mask(model)[cand.replica]
-        return cand.is_leadership() & wrong & (cand.dest_replica == preferred)
+        src_demoted = model.broker_state[cand.src] == BrokerState.DEMOTED
+        return (cand.is_leadership() & wrong
+                & ((cand.dest_replica == preferred) | src_demoted))
     if kind == "min_topic_leaders":
         return _min_leader_feasible(model, arrays, cand, constraint, unhealthy)
     if kind in ("intra_disk_capacity", "intra_disk_distribution"):
@@ -470,9 +483,13 @@ def score(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
     bonus = jnp.where(unhealthy & cand.is_move(), _OFFLINE_BONUS, 0.0)
     if kind == "preferred_leader":
         preferred = model.preferred_leader_replica()[cand.partition]
-        fixes = cand.is_leadership() & (cand.dest_replica == preferred) & \
-            _wrong_leader_mask(model)[cand.replica]
-        return jnp.where(fixes, 1.0, 0.0)
+        wrong = _wrong_leader_mask(model)[cand.replica]
+        src_demoted = model.broker_state[cand.src] == BrokerState.DEMOTED
+        to_pref = cand.dest_replica == preferred
+        fixes = cand.is_leadership() & wrong & (to_pref | src_demoted)
+        # Prefer the preferred replica when eligible; any other sibling still
+        # counts as a fix when draining a demoted broker.
+        return jnp.where(fixes, jnp.where(to_pref, 1.0, 0.5), 0.0)
     if kind == "min_topic_leaders":
         tlc = model.topic_leader_counts().astype(jnp.float32)
         t = model.replica_topic[cand.replica]

@@ -85,6 +85,25 @@ class ExecutionProposal:
         }
 
 
+def renumber_brokers(proposals: List[ExecutionProposal],
+                     broker_ids: List[int]) -> List[ExecutionProposal]:
+    """Map dense model broker indices → external cluster broker ids.
+
+    The tensor model addresses brokers by dense index 0..B-1 (sorted-id
+    order, LoadMonitor._build_model); the cluster protocol uses real broker
+    ids, which need not be contiguous.  The facade translates at this seam
+    before proposals reach the executor / REST payloads — passing dense
+    indices through (correct only when ids are exactly 0..B-1) was a
+    round-1 advisory finding."""
+    def pl(p: ReplicaPlacement) -> ReplicaPlacement:
+        return ReplicaPlacement(int(broker_ids[p.broker]), p.disk)
+
+    return [dataclasses.replace(
+        p, old_leader=pl(p.old_leader),
+        old_replicas=tuple(pl(x) for x in p.old_replicas),
+        new_replicas=tuple(pl(x) for x in p.new_replicas)) for p in proposals]
+
+
 def _partition_placements(model: TensorClusterModel):
     """Host arrays: per partition, ordered (leader first) replica placements."""
     pr = np.asarray(model.partition_replicas)          # [P, max_rf]

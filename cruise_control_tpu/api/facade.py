@@ -100,6 +100,22 @@ class CruiseControl:
     def _model(self) -> TensorClusterModel:
         return self.load_monitor.cluster_model(self.requirements)
 
+    def _model_naming(self) -> Tuple[TensorClusterModel, Dict[str, object]]:
+        """Model + id↔name maps from ONE metadata snapshot.  The tensor model
+        uses dense broker indices (sorted-id order); the cluster protocol uses
+        real, possibly non-contiguous ids.  All translation in an operation
+        must use this naming — a fresh ``naming()`` read could reflect a
+        membership change and misaddress every proposal."""
+        return self.load_monitor.cluster_model_and_naming(self.requirements)
+
+    @staticmethod
+    def _to_dense(naming: Dict[str, object], broker_ids: Sequence[int]) -> List[int]:
+        to_dense = {b: i for i, b in enumerate(naming["brokers"])}
+        missing = [b for b in broker_ids if b not in to_dense]
+        if missing:
+            raise ValueError(f"unknown broker ids {missing}")
+        return [to_dense[b] for b in broker_ids]
+
     def _optimize(self, model: TensorClusterModel, goals: Optional[Sequence[str]],
                   options: Optional[OptimizationOptions] = None) -> opt.OptimizerRun:
         goal_list = list(goals) if goals else self.goals
@@ -107,30 +123,38 @@ class CruiseControl:
         # (GoalBasedOperationRunnable skip-hard-goal-check semantics are an
         # explicit flag in the reference; default keeps them).
         return opt.optimize(model, goal_list, constraint=self.constraint,
-                            options=options, raise_on_hard_failure=False)
+                            options=options, raise_on_hard_failure=False,
+                            fused=True)
 
     def _finish(self, model: TensorClusterModel, run: opt.OptimizerRun,
-                dryrun: bool, reason: str,
+                dryrun: bool, reason: str, naming: Dict[str, object],
                 verify: bool = True) -> OperationResult:
-        proposals = props.diff(model, run.model)
+        # Verification runs on dense indices (the model's own numbering);
+        # everything leaving the facade — REST payloads and the executor's
+        # ReassignmentRequests / throttle entries — carries cluster ids from
+        # the SAME snapshot the model was built from.
+        dense_proposals = props.diff(model, run.model)
         if verify:
             try:
                 verify_run(model, run, [g.name for g in run.goal_results],
-                           constraint=self.constraint, proposals=proposals)
+                           constraint=self.constraint, proposals=dense_proposals)
             except VerificationError as e:
                 return OperationResult(
-                    ok=False, dryrun=dryrun, proposals=proposals,
+                    ok=False, dryrun=dryrun,
+                    proposals=props.renumber_brokers(
+                        dense_proposals, naming["brokers"]),
                     violated_goals_before=run.violated_goals_before,
                     violated_goals_after=run.violated_goals_after,
                     provision_status=run.provision_response.status.value,
                     stats_before=run.stats_before.to_dict(),
                     stats_after=run.stats_after.to_dict(),
                     reason=f"{reason} [verification failed: {e}]")
+        proposals = props.renumber_brokers(dense_proposals, naming["brokers"])
         execution = None
         ok = True
         if not dryrun and proposals:
             execution = self.executor.execute_proposals(
-                proposals, self.load_monitor.naming()["partitions"])
+                proposals, naming["partitions"])
             ok = execution.ok
         return OperationResult(
             ok=ok, dryrun=dryrun, proposals=proposals,
@@ -164,9 +188,10 @@ class CruiseControl:
                             stats_before=crun.stats_before.to_dict(),
                             stats_after=crun.stats_after.to_dict(),
                             reason="cached")
-        model = self._model()
+        model, naming = self._model_naming()
         run = self._optimize(model, goals)
-        result = self._finish(model, run, dryrun=True, reason="proposals")
+        result = self._finish(model, run, dryrun=True, reason="proposals",
+                              naming=naming)
         # Only verified-good runs are cacheable: a cached entry is always
         # served with ok=True.
         if use_cache and result.ok:
@@ -185,67 +210,101 @@ class CruiseControl:
                   destination_broker_ids: Optional[Sequence[int]] = None,
                   excluded_topics: Optional[Sequence[int]] = None,
                   reason: str = "rebalance") -> OperationResult:
-        model = self._model()
+        model, naming = self._model_naming()
         options = OptimizationOptions.none(model)
         if destination_broker_ids:
             mask = np.zeros(model.num_brokers, bool)
-            mask[list(destination_broker_ids)] = True
+            mask[self._to_dense(naming, destination_broker_ids)] = True
             options = options.replace(requested_dest_only=jnp.asarray(mask))
         if excluded_topics:
             tmask = np.zeros(model.num_topics, bool)
             tmask[list(excluded_topics)] = True
             options = options.replace(topic_excluded=jnp.asarray(tmask))
         run = self._optimize(model, goals, options)
-        return self._finish(model, run, dryrun, reason)
+        return self._finish(model, run, dryrun, reason, naming)
 
     def add_brokers(self, broker_ids: Sequence[int], dryrun: bool = False,
                     reason: str = "add_brokers") -> OperationResult:
         """Move load onto NEW brokers (AddBrokersRunnable)."""
-        model = self._model()
-        for b in broker_ids:
+        model, naming = self._model_naming()
+        for b in self._to_dense(naming, broker_ids):
             model = model.set_broker_state(b, BrokerState.NEW)
         self.executor.drop_recently_removed_brokers(list(broker_ids))
         run = self._optimize(model, self.goals)
-        return self._finish(model, run, dryrun, reason)
+        return self._finish(model, run, dryrun, reason, naming)
 
     def remove_brokers(self, broker_ids: Sequence[int], dryrun: bool = False,
                        reason: str = "remove_brokers") -> bool:
         """Decommission: drain all replicas off the brokers
         (RemoveBrokersRunnable)."""
-        model = self._model()
-        for b in broker_ids:
+        model, naming = self._model_naming()
+        for b in self._to_dense(naming, broker_ids):
             model = model.set_broker_state(b, BrokerState.DEAD)
         run = self._optimize(model, self.goals)
-        result = self._finish(model, run, dryrun, reason)
+        result = self._finish(model, run, dryrun, reason, naming)
         if result.ok and not dryrun:
             self.executor.add_recently_removed_brokers(list(broker_ids))
         return result.ok
 
     def demote_brokers(self, broker_ids: Sequence[int], dryrun: bool = False,
                        reason: str = "demote_brokers") -> bool:
-        """Move leadership (and preferred-leader order) off the brokers
-        (DemoteBrokerRunnable → PreferredLeaderElectionGoal with demoted
-        exclusions)."""
-        model = self._model()
-        for b in broker_ids:
+        """Transfer ALL leadership off the brokers (DemoteBrokerRunnable →
+        PreferredLeaderElectionGoal).  Reference parity: the runnable moves
+        demoted brokers' replicas to the end of the replica list and elects
+        new leaders; here every leader replica on a DEMOTED broker becomes a
+        mandatory leadership-transfer source (preferred_leader kernel), any
+        eligible non-demoted sibling the destination.  Reports ok only when
+        zero leaders remain on the demoted brokers."""
+        model, naming = self._model_naming()
+        dense = self._to_dense(naming, broker_ids)
+        for b in dense:
             model = model.set_broker_state(b, BrokerState.DEMOTED)
         options = OptimizationOptions.none(model)
         mask = np.zeros(model.num_brokers, bool)
-        mask[list(broker_ids)] = True
+        mask[dense] = True
         options = options.replace(broker_excluded_leadership=jnp.asarray(mask))
-        run = self._optimize(model, ["LeaderReplicaDistributionGoal"], options)
-        result = self._finish(model, run, dryrun, reason)
-        if result.ok and not dryrun:
+        run = self._optimize(model, ["PreferredLeaderElectionGoal"], options)
+        # Demotion must actually have happened: a no-op "ok" (leaders still
+        # on demoted brokers inside the leader-balance band) was a round-1
+        # advisory finding.  Leaders with no eligible non-demoted online
+        # sibling (e.g. RF=1 partitions) are unmovable and don't count
+        # against success — the reference succeeds after moving all movable
+        # leadership (DemoteBrokerRunnable skips URPs likewise).
+        leaders_left = self._movable_leaders_on(run.model, dense)
+        result = self._finish(model, run, dryrun, reason, naming)
+        ok = result.ok and leaders_left == 0
+        if ok and not dryrun:
             self.executor.add_recently_demoted_brokers(list(broker_ids))
-        return result.ok
+        return ok
+
+    @staticmethod
+    def _movable_leaders_on(model: TensorClusterModel, dense: Sequence[int]) -> int:
+        """Count leader replicas on the given (dense-index) brokers that have
+        at least one valid, online sibling on an alive non-demoted broker."""
+        rb = np.asarray(model.replica_broker)
+        lead = np.asarray(model.replica_is_leader)
+        valid = np.asarray(model.replica_valid)
+        part = np.asarray(model.replica_partition)
+        pr = np.asarray(model.partition_replicas)
+        state = np.asarray(model.broker_state)
+        offline = np.asarray(model.replica_offline_now())
+        count = 0
+        for r in np.nonzero(lead & valid & np.isin(rb, list(dense)))[0]:
+            for s in pr[part[r]]:
+                if s < 0 or s == r or not valid[s] or offline[s]:
+                    continue
+                if state[rb[s]] not in (BrokerState.DEAD, BrokerState.DEMOTED):
+                    count += 1
+                    break
+        return count
 
     def fix_offline_replicas(self, dryrun: bool = False,
                              reason: str = "fix_offline_replicas") -> bool:
         """Heal offline replicas via the hard-goal stack
         (FixOfflineReplicasRunnable)."""
-        model = self._model()
+        model, naming = self._model_naming()
         run = self._optimize(model, self.hard_goals)
-        return self._finish(model, run, dryrun, reason).ok
+        return self._finish(model, run, dryrun, reason, naming).ok
 
     def update_topic_replication_factor(self, topics_rf: Dict[str, int],
                                         dryrun: bool = False,

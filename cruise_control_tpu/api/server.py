@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import base64
 import dataclasses
+import hmac
 import json
 import threading
 import time
@@ -79,7 +80,9 @@ class BasicSecurityProvider(SecurityProvider):
         except Exception:  # noqa: BLE001 — malformed header
             return None
         entry = self._creds.get(user)
-        if entry is None or entry[0] != pw:
+        # Compare as bytes: compare_digest on str raises for non-ASCII input,
+        # which would crash the request instead of returning 401.
+        if entry is None or not hmac.compare_digest(entry[0].encode(), pw.encode()):
             return None
         return entry[1]
 
@@ -159,11 +162,15 @@ class CruiseControlApi:
                              "status": req.status,
                              "message": "request parked for review"}, {}
             try:
-                req = self.purgatory.take_approved(int(rid), endpoint)
+                rid = int(rid)
+            except ValueError:
+                return 400, {"error": f"invalid review_id {rid!r}"}, {}
+            try:
+                req = self.purgatory.take_approved(rid, endpoint)
             except (KeyError, ValueError) as e:
                 # Polling an already-SUBMITTED review must keep returning the
                 # running/completed task instead of failing the client.
-                task = self.user_tasks.find_by_key(("review", endpoint, int(rid)))
+                task = self.user_tasks.find_by_key(("review", endpoint, rid))
                 if task is not None:
                     return self._task_response(task)
                 return 400, {"error": str(e)}, {}
@@ -397,9 +404,9 @@ class CruiseControlApi:
                         {"before": old, "after": value}
         conc = q.get("concurrent_partition_movements_per_broker")
         if conc is not None:
-            limits = self.cc.executor._limits
-            limits = dataclasses.replace(limits, inter_broker_per_broker=int(conc))
-            self.cc.executor._limits = limits
+            limits = dataclasses.replace(self.cc.executor.limits,
+                                         inter_broker_per_broker=int(conc))
+            self.cc.executor.set_concurrency(limits)
             out["interBrokerPartitionMovementConcurrency"] = int(conc)
         drop = _parse_ids(q, "drop_recently_removed_brokers")
         if drop:

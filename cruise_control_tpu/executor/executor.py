@@ -131,6 +131,22 @@ class Executor:
             return self._state
 
     @property
+    def limits(self) -> ConcurrencyLimits:
+        with self._lock:
+            return self._limits
+
+    def set_concurrency(self, limits: ConcurrencyLimits) -> None:
+        """Dynamically change movement concurrency (ADMIN endpoint;
+        KafkaCruiseControl.setConcurrency analogue).  Updates the configured
+        limits, the adjuster's base (so auto-adjustment re-expands to the new
+        cap, not the stale one), and any live execution's task manager."""
+        with self._lock:
+            self._limits = limits
+            self._adjuster = ConcurrencyAdjuster(limits)
+            if self._task_manager is not None:
+                self._task_manager.set_limits(limits)
+
+    @property
     def has_ongoing_execution(self) -> bool:
         return self.state() not in (ExecutorState.NO_TASK_IN_PROGRESS,
                                     ExecutorState.GENERATING_PROPOSALS_FOR_EXECUTION)

@@ -1,0 +1,28 @@
+"""Kafka edge adapters: wire-protocol client + production SPI bindings.
+
+The reference talks to Kafka through the JVM client libraries
+(AdminClient/consumer/producer — ExecutorUtils.scala:21,
+KafkaSampleStore.java:69, CruiseControlMetricsReporterSampler.java:36,
+common/MetadataClient.java).  This build has no JVM and no third-party
+Kafka package, so the adapters speak the Kafka wire protocol directly over
+stdlib sockets (`protocol.py` + `client.py`) — the protocol is an open,
+versioned spec, and the subset needed here (metadata, produce/fetch,
+admin reassignment/config/election APIs) is small and stable.
+
+Bindings (each implements an existing SPI from the core packages):
+
+- ``KafkaClusterAdmin``    → executor.admin.ClusterAdmin
+- ``KafkaMetadataClient``  → monitor.metadata.MetadataClient refresh source
+- ``KafkaMetricSampler``   → monitor.sampling.MetricSampler
+- ``KafkaSampleStore``     → monitor.sample_store.SampleStore
+
+Tests run against ``tests/kafka_fake_broker.py`` — an in-process TCP server
+speaking the same wire protocol over an in-memory log, the translation of
+the reference's embedded-Kafka harness (CCEmbeddedBroker,
+cruise-control-metrics-reporter/src/test/.../utils/) for an image without
+a JVM.
+"""
+
+from cruise_control_tpu.kafka.client import KafkaClient, KafkaError
+
+__all__ = ["KafkaClient", "KafkaError"]

@@ -1,0 +1,131 @@
+"""ClusterAdmin bound to a real Kafka cluster over the wire protocol.
+
+The production implementation of ``executor.admin.ClusterAdmin`` — the
+mutation path the reference implements with KafkaZkClient/AdminClient
+(ExecutorUtils.scala:21 merging /admin/reassign_partitions,
+ExecutorAdminUtils.java electLeaders/describeLogDirs,
+ReplicationThrottleHelper.java throttle configs).  This build targets the
+AdminClient-era APIs only: AlterPartitionReassignments (KIP-455) instead of
+the ZK znode, IncrementalAlterConfigs for throttles, ElectLeaders for PLE.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from cruise_control_tpu.executor.admin import ClusterAdmin, ReassignmentRequest, Tp
+from cruise_control_tpu.kafka.client import KafkaClient, KafkaError
+
+# Kafka config resource types
+RESOURCE_TOPIC = 2
+RESOURCE_BROKER = 4
+
+# IncrementalAlterConfigs ops
+OP_SET, OP_DELETE, OP_APPEND, OP_SUBTRACT = 0, 1, 2, 3
+
+LEADER_THROTTLE_RATE = "leader.replication.throttled.rate"
+FOLLOWER_THROTTLE_RATE = "follower.replication.throttled.rate"
+LEADER_THROTTLED_REPLICAS = "leader.replication.throttled.replicas"
+FOLLOWER_THROTTLED_REPLICAS = "follower.replication.throttled.replicas"
+
+
+class KafkaClusterAdmin(ClusterAdmin):
+    def __init__(self, client: KafkaClient):
+        self._client = client
+        self._lock = threading.Lock()
+
+    # -- reassignment ------------------------------------------------------
+    def alter_partition_reassignments(self, requests: Sequence[ReassignmentRequest]) -> None:
+        assignments = {tuple(r.tp): list(r.new_replicas) for r in requests}
+        errors = self._client.alter_partition_reassignments(assignments)
+        bad = {tp: code for tp, code in errors.items() if code}
+        if bad:
+            raise KafkaError(next(iter(bad.values())),
+                             f"alter_partition_reassignments failed for {sorted(bad)}")
+
+    def ongoing_reassignments(self) -> Set[Tp]:
+        return set(self._client.list_partition_reassignments())
+
+    def cancel_reassignments(self, tps: Optional[Sequence[Tp]] = None) -> None:
+        targets = list(tps) if tps is not None else \
+            list(self._client.list_partition_reassignments())
+        if targets:
+            self._client.alter_partition_reassignments(
+                {tuple(tp): None for tp in targets})
+
+    # -- leadership --------------------------------------------------------
+    def elect_leaders(self, tps: Sequence[Tp]) -> None:
+        errors = self._client.elect_leaders([tuple(tp) for tp in tps])
+        # ELECTION_NOT_NEEDED (84) means the preferred replica already leads.
+        bad = {tp: c for tp, c in errors.items() if c not in (0, 84)}
+        if bad:
+            raise KafkaError(next(iter(bad.values())),
+                             f"elect_leaders failed for {sorted(bad)}")
+
+    # -- logdirs -----------------------------------------------------------
+    def alter_replica_logdirs(self, moves: Sequence[Tuple[Tp, int, str]]) -> None:
+        by_broker: Dict[int, Dict[str, List[Tp]]] = {}
+        for tp, broker, logdir in moves:
+            by_broker.setdefault(broker, {}).setdefault(logdir, []).append(tuple(tp))
+        for broker, dirs in by_broker.items():
+            self._client.alter_replica_logdirs(broker, dirs)
+
+    def describe_logdirs(self) -> Dict[int, Dict[str, bool]]:
+        md = self._client.metadata()
+        out: Dict[int, Dict[str, bool]] = {}
+        for b in md.brokers:
+            try:
+                dirs = self._client.describe_logdirs(b.node_id)
+            except (KafkaError, ConnectionError, OSError):
+                continue
+            out[b.node_id] = {path: err == 0 for path, (err, _) in dirs.items()}
+        return out
+
+    # -- throttles (ReplicationThrottleHelper.java semantics) ---------------
+    def set_replication_throttles(self, rate_bytes_per_sec: int,
+                                  brokers: Sequence[int],
+                                  throttled_replicas: Dict[str, List[str]]) -> None:
+        resources = []
+        for b in brokers:
+            resources.append((RESOURCE_BROKER, str(b), [
+                (LEADER_THROTTLE_RATE, OP_SET, str(rate_bytes_per_sec)),
+                (FOLLOWER_THROTTLE_RATE, OP_SET, str(rate_bytes_per_sec)),
+            ]))
+        for topic, entries in throttled_replicas.items():
+            val = ",".join(entries)
+            resources.append((RESOURCE_TOPIC, topic, [
+                (LEADER_THROTTLED_REPLICAS, OP_APPEND, val),
+                (FOLLOWER_THROTTLED_REPLICAS, OP_APPEND, val),
+            ]))
+        if resources:
+            self._client.incremental_alter_configs(resources)
+
+    def clear_replication_throttles(self, brokers: Sequence[int],
+                                    throttled_replicas: Dict[str, List[str]]) -> None:
+        # Diff-based cleanup: remove exactly our entries (APPEND's inverse,
+        # SUBTRACT), drop the rate keys on the brokers — operator-set topic
+        # throttle lists not added by us survive.
+        resources = []
+        for topic, entries in throttled_replicas.items():
+            val = ",".join(entries)
+            resources.append((RESOURCE_TOPIC, topic, [
+                (LEADER_THROTTLED_REPLICAS, OP_SUBTRACT, val),
+                (FOLLOWER_THROTTLED_REPLICAS, OP_SUBTRACT, val),
+            ]))
+        for b in brokers:
+            resources.append((RESOURCE_BROKER, str(b), [
+                (LEADER_THROTTLE_RATE, OP_DELETE, None),
+                (FOLLOWER_THROTTLE_RATE, OP_DELETE, None),
+            ]))
+        if resources:
+            self._client.incremental_alter_configs(resources)
+
+    # -- topic config ------------------------------------------------------
+    def min_isr(self, topic: str) -> int:
+        cfgs = self._client.describe_configs([(RESOURCE_TOPIC, topic)])
+        value = cfgs.get((RESOURCE_TOPIC, topic), {}).get("min.insync.replicas", "1")
+        try:
+            return int(value)
+        except (TypeError, ValueError):
+            return 1

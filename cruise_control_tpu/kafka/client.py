@@ -1,0 +1,591 @@
+"""Kafka wire-protocol client over stdlib sockets.
+
+One ``KafkaClient`` owns a connection per broker plus bootstrap handling,
+correlation-id bookkeeping, and typed request/response methods for the API
+subset in ``protocol.py``.  Synchronous by design — every caller in this
+framework (executor poll loop, sampler fetch, metadata refresh) is already
+a poll-driven thread, matching the "keep it boring and synchronous" stance
+of SURVEY.md §7 step 5.
+
+Reference seams being bound: ExecutorUtils.scala:21 / ExecutorAdminUtils.java
+(reassignments, elections, logdirs), common/MetadataClient.java (metadata),
+KafkaSampleStore.java:69 + CruiseControlMetricsReporterSampler.java:36
+(produce/fetch).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from cruise_control_tpu.kafka import protocol as proto
+from cruise_control_tpu.kafka.protocol import Reader, Record, Writer
+
+Tp = Tuple[str, int]
+
+
+class KafkaError(Exception):
+    def __init__(self, code: int, context: str = ""):
+        super().__init__(f"{proto.error_name(code)} ({code}) {context}")
+        self.code = code
+
+
+@dataclasses.dataclass(frozen=True)
+class BrokerEndpoint:
+    node_id: int
+    host: str
+    port: int
+    rack: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionMetadata:
+    topic: str
+    partition: int
+    leader: int
+    replicas: Tuple[int, ...]
+    isr: Tuple[int, ...]
+    error: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class MetadataResponse:
+    brokers: Tuple[BrokerEndpoint, ...]
+    controller_id: int
+    partitions: Tuple[PartitionMetadata, ...]
+
+    def topics(self) -> List[str]:
+        seen: Dict[str, None] = {}
+        for p in self.partitions:
+            seen.setdefault(p.topic, None)
+        return list(seen)
+
+
+class _Conn:
+    """One broker connection: framed send/recv, serialized by a lock."""
+
+    def __init__(self, host: str, port: int, client_id: str, timeout_s: float):
+        self._sock = socket.create_connection((host, port), timeout=timeout_s)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._client_id = client_id
+        self._corr = 0
+        self._lock = threading.Lock()
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def roundtrip(self, api_key: int, payload: bytes) -> Reader:
+        with self._lock:
+            self._corr += 1
+            corr = self._corr
+            frame = proto.encode_request(api_key, corr, self._client_id, payload)
+            self._sock.sendall(frame)
+            raw = self._recv_frame()
+        got_corr, reader = proto.decode_response_header(api_key, raw)
+        if got_corr != corr:
+            raise KafkaError(-1, f"correlation mismatch {got_corr} != {corr}")
+        return reader
+
+    def _recv_frame(self) -> bytes:
+        hdr = self._recv_exact(4)
+        (n,) = struct.unpack(">i", hdr)
+        return self._recv_exact(n)
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("broker closed connection")
+            buf.extend(chunk)
+        return bytes(buf)
+
+
+class KafkaClient:
+    """Minimal cluster client: bootstrap → metadata → per-broker routing."""
+
+    def __init__(self, bootstrap: Sequence[Tuple[str, int]],
+                 client_id: str = "cruise-control-tpu", timeout_s: float = 30.0):
+        self._bootstrap = list(bootstrap)
+        self._client_id = client_id
+        self._timeout = timeout_s
+        self._conns: Dict[int, _Conn] = {}
+        self._endpoints: Dict[int, BrokerEndpoint] = {}
+        self._controller_id = -1
+        self._lock = threading.Lock()
+
+    # -- connections -------------------------------------------------------
+    def close(self) -> None:
+        with self._lock:
+            for c in self._conns.values():
+                c.close()
+            self._conns.clear()
+
+    def _bootstrap_conn(self) -> _Conn:
+        err: Optional[Exception] = None
+        for host, port in self._bootstrap:
+            try:
+                return _Conn(host, port, self._client_id, self._timeout)
+            except OSError as e:
+                err = e
+        raise ConnectionError(f"no bootstrap broker reachable: {err}")
+
+    def _conn(self, node_id: Optional[int] = None) -> _Conn:
+        with self._lock:
+            if node_id is None:
+                if self._conns:
+                    return next(iter(self._conns.values()))
+            elif node_id in self._conns:
+                return self._conns[node_id]
+        if node_id is None or node_id not in self._endpoints:
+            conn = self._bootstrap_conn()
+            if node_id is None:
+                with self._lock:
+                    self._conns.setdefault(-1, conn)
+                return conn
+            conn.close()
+            self.metadata()  # refresh endpoints, then retry
+            if node_id not in self._endpoints:
+                raise KafkaError(-1, f"unknown broker {node_id}")
+        ep = self._endpoints[node_id]
+        conn = _Conn(ep.host, ep.port, self._client_id, self._timeout)
+        with self._lock:
+            old = self._conns.get(node_id)
+            if old is not None and old is not conn:
+                old.close()
+            self._conns[node_id] = conn
+        return conn
+
+    def _drop_conn(self, node_id: Optional[int]) -> None:
+        with self._lock:
+            for key in ([node_id] if node_id is not None else list(self._conns)):
+                c = self._conns.pop(key, None)
+                if c is not None:
+                    c.close()
+
+    def _roundtrip(self, api_key: int, payload: bytes,
+                   node_id: Optional[int] = None) -> Reader:
+        try:
+            return self._conn(node_id).roundtrip(api_key, payload)
+        except (ConnectionError, OSError):
+            self._drop_conn(node_id)
+            return self._conn(node_id).roundtrip(api_key, payload)
+
+    def _controller_roundtrip(self, api_key: int, payload: bytes) -> Reader:
+        if self._controller_id < 0:
+            self.metadata()
+        return self._roundtrip(api_key, payload,
+                               self._controller_id if self._controller_id >= 0 else None)
+
+    # -- Metadata (v1) -----------------------------------------------------
+    def metadata(self, topics: Optional[Sequence[str]] = None) -> MetadataResponse:
+        w = Writer()
+        w.array(topics, lambda wr, t: wr.string(t))  # None = all topics
+        r = self._roundtrip(proto.API_METADATA, w.bytes())
+        brokers = tuple(r.array(lambda rr: BrokerEndpoint(
+            node_id=rr.i32(), host=rr.string(), port=rr.i32(),
+            rack=rr.string())) or ())
+        controller_id = r.i32()
+        partitions: List[PartitionMetadata] = []
+
+        def topic_fn(rr: Reader):
+            rr.i16()  # topic error
+            name = rr.string()
+            rr.boolean()  # is_internal
+            def part_fn(pr: Reader):
+                err = pr.i16()
+                pid = pr.i32()
+                leader = pr.i32()
+                replicas = tuple(pr.array(lambda x: x.i32()) or ())
+                isr = tuple(pr.array(lambda x: x.i32()) or ())
+                partitions.append(PartitionMetadata(
+                    topic=name, partition=pid, leader=leader,
+                    replicas=replicas, isr=isr, error=err))
+            rr.array(part_fn)
+        r.array(topic_fn)
+        with self._lock:
+            self._endpoints = {b.node_id: b for b in brokers}
+            self._controller_id = controller_id
+        return MetadataResponse(brokers=brokers, controller_id=controller_id,
+                                partitions=tuple(sorted(
+                                    partitions, key=lambda p: (p.topic, p.partition))))
+
+    # -- Produce (v3, acks=-1) --------------------------------------------
+    def produce(self, tp: Tp, records: Sequence[Record],
+                leader: Optional[int] = None) -> int:
+        """Produce one batch to a partition; returns the base offset."""
+        batch = proto.encode_record_batch(records)
+        w = Writer()
+        w.string(None)      # transactional id
+        w.i16(-1)           # acks = all
+        w.i32(30_000)       # timeout
+        def topic_fn(wr: Writer, _):
+            wr.string(tp[0])
+            wr.array([0], lambda wp, __: wp.i32(tp[1]).nbytes(batch))
+        w.array([0], topic_fn)
+        r = self._roundtrip(proto.API_PRODUCE, w.bytes(), leader)
+        base_offset = -1
+        err_holder = [0]
+
+        def topic_resp(rr: Reader):
+            rr.string()
+            def part_resp(pr: Reader):
+                nonlocal base_offset
+                pr.i32()  # partition
+                err = pr.i16()
+                off = pr.i64()
+                pr.i64()  # log append time
+                if err:
+                    err_holder[0] = err
+                else:
+                    base_offset = off
+            rr.array(part_resp)
+        r.array(topic_resp)
+        r.i32()  # throttle
+        if err_holder[0]:
+            raise KafkaError(err_holder[0], f"produce {tp}")
+        return base_offset
+
+    # -- Fetch (v4) --------------------------------------------------------
+    def fetch(self, tp: Tp, offset: int, max_bytes: int = 4 * 1024 * 1024,
+              leader: Optional[int] = None) -> Tuple[List[Record], int]:
+        """Fetch records from ``offset``; returns (records, high_watermark)."""
+        w = Writer()
+        w.i32(-1)        # replica id (consumer)
+        w.i32(100)       # max wait ms
+        w.i32(1)         # min bytes
+        w.i32(max_bytes)  # max bytes (v3+)
+        w.i8(0)          # isolation level (v4+)
+        def topic_fn(wr: Writer, _):
+            wr.string(tp[0])
+            wr.array([0], lambda wp, __: wp.i32(tp[1]).i64(offset).i32(max_bytes))
+        w.array([0], topic_fn)
+        r = self._roundtrip(proto.API_FETCH, w.bytes(), leader)
+        r.i32()  # throttle
+        records: List[Record] = []
+        hwm = -1
+        err_holder = [0]
+
+        def topic_resp(rr: Reader):
+            nonlocal hwm
+            rr.string()
+            def part_resp(pr: Reader):
+                nonlocal hwm
+                pr.i32()         # partition
+                err = pr.i16()
+                hw = pr.i64()
+                pr.i64()         # last stable offset (v4)
+                pr.array(lambda ar: (ar.i64(), ar.i64()))  # aborted txns
+                data = pr.nbytes()
+                if err:
+                    err_holder[0] = err
+                else:
+                    hwm = hw
+                    if data:
+                        records.extend(proto.decode_record_batches(data))
+            rr.array(part_resp)
+        r.array(topic_resp)
+        if err_holder[0]:
+            raise KafkaError(err_holder[0], f"fetch {tp}@{offset}")
+        return [rec for rec in records if rec.offset >= offset], hwm
+
+    # -- ListOffsets (v1) --------------------------------------------------
+    def list_offset(self, tp: Tp, timestamp: int = -1,
+                    leader: Optional[int] = None) -> int:
+        """-1 = latest, -2 = earliest (ListOffsetsRequest semantics)."""
+        w = Writer()
+        w.i32(-1)  # replica id
+        def topic_fn(wr: Writer, _):
+            wr.string(tp[0])
+            wr.array([0], lambda wp, __: wp.i32(tp[1]).i64(timestamp))
+        w.array([0], topic_fn)
+        r = self._roundtrip(proto.API_LIST_OFFSETS, w.bytes(), leader)
+        result = [-1]
+        err_holder = [0]
+
+        def topic_resp(rr: Reader):
+            rr.string()
+            def part_resp(pr: Reader):
+                pr.i32()
+                err = pr.i16()
+                pr.i64()  # timestamp
+                off = pr.i64()
+                if err:
+                    err_holder[0] = err
+                else:
+                    result[0] = off
+            rr.array(part_resp)
+        r.array(topic_resp)
+        if err_holder[0]:
+            raise KafkaError(err_holder[0], f"list_offset {tp}")
+        return result[0]
+
+    # -- CreateTopics (v1) -------------------------------------------------
+    def create_topics(self, topics: Dict[str, Tuple[int, int]],
+                      configs: Optional[Dict[str, Dict[str, str]]] = None,
+                      validate_only: bool = False) -> Dict[str, int]:
+        """{topic: (num_partitions, replication_factor)} → {topic: error}."""
+        w = Writer()
+        def topic_fn(wr: Writer, name: str):
+            nparts, rf = topics[name]
+            wr.string(name).i32(nparts).i16(rf)
+            wr.array([], lambda *_: None)  # manual assignments
+            cfg = (configs or {}).get(name, {})
+            wr.array(list(cfg.items()),
+                     lambda wc, kv: wc.string(kv[0]).string(kv[1]))
+        w.array(list(topics), topic_fn)
+        w.i32(30_000).boolean(validate_only)
+        r = self._controller_roundtrip(proto.API_CREATE_TOPICS, w.bytes())
+        out: Dict[str, int] = {}
+
+        def resp(rr: Reader):
+            name = rr.string()
+            out[name] = rr.i16()
+            rr.string()  # error message (v1)
+        r.array(resp)
+        return out
+
+    # -- AlterPartitionReassignments (v0, flexible) -------------------------
+    def alter_partition_reassignments(
+            self, assignments: Dict[Tp, Optional[Sequence[int]]]) -> Dict[Tp, int]:
+        """{tp: replica list} (None cancels). Returns {tp: error code}."""
+        by_topic: Dict[str, List[Tuple[int, Optional[Sequence[int]]]]] = {}
+        for (t, p), reps in assignments.items():
+            by_topic.setdefault(t, []).append((p, reps))
+        w = Writer()
+        w.i32(30_000)  # timeout
+        def topic_fn(wr: Writer, t: str):
+            wr.cstring(t)
+            def part_fn(wp: Writer, item):
+                pid, reps = item
+                wp.i32(pid)
+                wp.carray(list(reps) if reps is not None else None,
+                          lambda wx, b: wx.i32(b))
+                wp.tags()
+            wr.carray(by_topic[t], part_fn)
+            wr.tags()
+        w.carray(list(by_topic), topic_fn)
+        w.tags()
+        r = self._controller_roundtrip(
+            proto.API_ALTER_PARTITION_REASSIGNMENTS, w.bytes())
+        r.i32()  # throttle
+        top_err = r.i16()
+        r.cstring()  # top-level message
+        out: Dict[Tp, int] = {}
+
+        def topic_resp(rr: Reader):
+            t = rr.cstring()
+            def part_resp(pr: Reader):
+                pid = pr.i32()
+                err = pr.i16()
+                pr.cstring()
+                pr.tags()
+                out[(t, pid)] = err
+            rr.carray(part_resp)
+            rr.tags()
+        r.carray(topic_resp)
+        r.tags()
+        if top_err:
+            raise KafkaError(top_err, "alter_partition_reassignments")
+        return out
+
+    # -- ListPartitionReassignments (v0, flexible) -------------------------
+    def list_partition_reassignments(self) -> Dict[Tp, Tuple[Tuple[int, ...],
+                                                             Tuple[int, ...],
+                                                             Tuple[int, ...]]]:
+        """→ {tp: (replicas, adding, removing)} for in-flight reassignments."""
+        w = Writer()
+        w.i32(30_000)
+        w.carray(None, lambda *_: None)  # None = all topics
+        w.tags()
+        r = self._controller_roundtrip(
+            proto.API_LIST_PARTITION_REASSIGNMENTS, w.bytes())
+        r.i32()  # throttle
+        err = r.i16()
+        r.cstring()
+        out: Dict[Tp, Tuple[Tuple[int, ...], Tuple[int, ...], Tuple[int, ...]]] = {}
+
+        def topic_resp(rr: Reader):
+            t = rr.cstring()
+            def part_resp(pr: Reader):
+                pid = pr.i32()
+                reps = tuple(pr.carray(lambda x: x.i32()) or ())
+                adding = tuple(pr.carray(lambda x: x.i32()) or ())
+                removing = tuple(pr.carray(lambda x: x.i32()) or ())
+                pr.tags()
+                out[(t, pid)] = (reps, adding, removing)
+            rr.carray(part_resp)
+            rr.tags()
+        r.carray(topic_resp)
+        r.tags()
+        if err:
+            raise KafkaError(err, "list_partition_reassignments")
+        return out
+
+    # -- ElectLeaders (v1) -------------------------------------------------
+    def elect_leaders(self, tps: Sequence[Tp],
+                      election_type: int = 0) -> Dict[Tp, int]:
+        """Preferred (0) / unclean (1) leader election. → {tp: error}."""
+        by_topic: Dict[str, List[int]] = {}
+        for t, p in tps:
+            by_topic.setdefault(t, []).append(p)
+        w = Writer()
+        w.i8(election_type)  # v1
+        def topic_fn(wr: Writer, t: str):
+            wr.string(t)
+            wr.array(by_topic[t], lambda wp, pid: wp.i32(pid))
+        w.array(list(by_topic), topic_fn)
+        w.i32(30_000)
+        r = self._controller_roundtrip(proto.API_ELECT_LEADERS, w.bytes())
+        r.i32()  # throttle
+        r.i16()  # top error (v1)
+        out: Dict[Tp, int] = {}
+
+        def topic_resp(rr: Reader):
+            t = rr.string()
+            def part_resp(pr: Reader):
+                pid = pr.i32()
+                err = pr.i16()
+                pr.string()  # message
+                out[(t, pid)] = err
+            rr.array(part_resp)
+        r.array(topic_resp)
+        return out
+
+    # -- IncrementalAlterConfigs (v0) --------------------------------------
+    # op codes: 0=SET, 1=DELETE, 2=APPEND, 3=SUBTRACT
+    def incremental_alter_configs(
+            self, resources: Sequence[Tuple[int, str, Sequence[Tuple[str, int, Optional[str]]]]],
+            validate_only: bool = False) -> Dict[Tuple[int, str], int]:
+        """[(resource_type, resource_name, [(key, op, value)])] →
+        {(type, name): error}.  Resource types: 2=topic, 4=broker."""
+        w = Writer()
+        def res_fn(wr: Writer, item):
+            rtype, rname, cfgs = item
+            wr.i8(rtype).string(rname)
+            wr.array(list(cfgs),
+                     lambda wc, kv: wc.string(kv[0]).i8(kv[1]).string(kv[2]))
+        w.array(list(resources), res_fn)
+        w.boolean(validate_only)
+        r = self._controller_roundtrip(
+            proto.API_INCREMENTAL_ALTER_CONFIGS, w.bytes())
+        r.i32()  # throttle
+        out: Dict[Tuple[int, str], int] = {}
+
+        def resp(rr: Reader):
+            err = rr.i16()
+            rr.string()  # message
+            rtype = rr.i8()
+            rname = rr.string()
+            out[(rtype, rname)] = err
+        r.array(resp)
+        return out
+
+    # -- DescribeConfigs (v1) ----------------------------------------------
+    def describe_configs(self, resources: Sequence[Tuple[int, str]]
+                         ) -> Dict[Tuple[int, str], Dict[str, str]]:
+        w = Writer()
+        def res_fn(wr: Writer, item):
+            rtype, rname = item
+            wr.i8(rtype).string(rname)
+            wr.array(None, lambda *_: None)  # all config keys
+        w.array(list(resources), res_fn)
+        w.boolean(False)  # include synonyms (v1)
+        r = self._controller_roundtrip(proto.API_DESCRIBE_CONFIGS, w.bytes())
+        r.i32()  # throttle
+        out: Dict[Tuple[int, str], Dict[str, str]] = {}
+
+        def resp(rr: Reader):
+            err = rr.i16()
+            rr.string()  # message
+            rtype = rr.i8()
+            rname = rr.string()
+            cfg: Dict[str, str] = {}
+            def entry(er: Reader):
+                k = er.string()
+                v = er.string()
+                er.boolean()  # read only
+                er.i8()       # config source (v1)
+                er.boolean()  # is sensitive
+                er.array(lambda sr: (sr.string(), sr.string(), sr.i8()))  # synonyms
+                if k is not None:
+                    cfg[k] = v if v is not None else ""
+            rr.array(entry)
+            if not err:
+                out[(rtype, rname)] = cfg
+        r.array(resp)
+        return out
+
+    # -- DescribeLogDirs (v1) ----------------------------------------------
+    def describe_logdirs(self, node_id: int) -> Dict[str, Tuple[int, Dict[Tp, int]]]:
+        """→ {logdir: (error, {tp: size_bytes})} for one broker."""
+        w = Writer()
+        w.array(None, lambda *_: None)  # all topics
+        r = self._roundtrip(proto.API_DESCRIBE_LOG_DIRS, w.bytes(), node_id)
+        r.i32()  # throttle
+        out: Dict[str, Tuple[int, Dict[Tp, int]]] = {}
+
+        def dir_fn(rr: Reader):
+            err = rr.i16()
+            path = rr.string()
+            sizes: Dict[Tp, int] = {}
+            def topic_fn(tr: Reader):
+                t = tr.string()
+                def part_fn(pr: Reader):
+                    pid = pr.i32()
+                    size = pr.i64()
+                    pr.i64()      # offset lag
+                    pr.boolean()  # is future
+                    sizes[(t, pid)] = size
+                tr.array(part_fn)
+            rr.array(topic_fn)
+            out[path] = (err, sizes)
+        r.array(dir_fn)
+        return out
+
+    # -- AlterReplicaLogDirs (v1) ------------------------------------------
+    def alter_replica_logdirs(self, node_id: int,
+                              moves: Dict[str, Sequence[Tp]]) -> Dict[Tp, int]:
+        """{target_logdir: [tps]} on one broker → {tp: error}."""
+        w = Writer()
+        def dir_fn(wr: Writer, path: str):
+            wr.string(path)
+            by_topic: Dict[str, List[int]] = {}
+            for t, p in moves[path]:
+                by_topic.setdefault(t, []).append(p)
+            def topic_fn(wt: Writer, t: str):
+                wt.string(t)
+                wt.array(by_topic[t], lambda wp, pid: wp.i32(pid))
+            wr.array(list(by_topic), topic_fn)
+        w.array(list(moves), dir_fn)
+        r = self._roundtrip(proto.API_ALTER_REPLICA_LOG_DIRS, w.bytes(), node_id)
+        r.i32()  # throttle
+        out: Dict[Tp, int] = {}
+
+        def topic_resp(rr: Reader):
+            t = rr.string()
+            def part_resp(pr: Reader):
+                pid = pr.i32()
+                out[(t, pid)] = pr.i16()
+            rr.array(part_resp)
+        r.array(topic_resp)
+        return out
+
+    # -- ApiVersions (v0) --------------------------------------------------
+    def api_versions(self) -> Dict[int, Tuple[int, int]]:
+        r = self._roundtrip(proto.API_API_VERSIONS, b"")
+        err = r.i16()
+        out: Dict[int, Tuple[int, int]] = {}
+        def fn(rr: Reader):
+            k = rr.i16()
+            out[k] = (rr.i16(), rr.i16())
+        r.array(fn)
+        if err:
+            raise KafkaError(err, "api_versions")
+        return out

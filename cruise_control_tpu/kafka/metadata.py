@@ -1,0 +1,62 @@
+"""Cluster metadata sourced from a live Kafka cluster.
+
+Production refresh source for ``monitor.metadata.MetadataClient`` — the
+reference's TTL-cached metadata with a generation counter
+(common/MetadataClient.java).  Polls the wire-protocol Metadata API and
+converts to the monitor's ``ClusterMetadata`` snapshot shape; internal
+topics (``__*``) are kept (the reference models them too) but callers can
+filter.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional, Sequence, Set
+
+from cruise_control_tpu.kafka.client import KafkaClient
+from cruise_control_tpu.monitor.metadata import (BrokerInfo, ClusterMetadata,
+                                                 MetadataClient, PartitionInfo)
+
+
+def cluster_metadata_from_kafka(client: KafkaClient,
+                                exclude_topics: Sequence[str] = ()) -> ClusterMetadata:
+    md = client.metadata()
+    alive_ids: Set[int] = {b.node_id for b in md.brokers}
+    brokers = tuple(BrokerInfo(
+        broker_id=b.node_id, rack=b.rack or f"rack-{b.node_id}",
+        host=b.host, is_alive=True) for b in md.brokers)
+    skip = set(exclude_topics)
+    partitions = []
+    for p in md.partitions:
+        if p.topic in skip:
+            continue
+        offline = tuple(b for b in p.replicas
+                        if b not in alive_ids or b not in p.isr and p.leader < 0)
+        partitions.append(PartitionInfo(
+            topic=p.topic, partition=p.partition, leader=p.leader,
+            replicas=p.replicas, offline_replicas=offline))
+    return ClusterMetadata(brokers=brokers, partitions=tuple(partitions))
+
+
+class KafkaMetadataRefresher:
+    """TTL-based refresher: call ``maybe_refresh()`` from any poll loop; the
+    shared MetadataClient snapshot advances its generation only on change."""
+
+    def __init__(self, client: KafkaClient, metadata_client: MetadataClient,
+                 ttl_ms: int = 5_000, exclude_topics: Sequence[str] = ()):
+        self._client = client
+        self._md = metadata_client
+        self._ttl_s = ttl_ms / 1000.0
+        self._exclude = tuple(exclude_topics)
+        self._last = 0.0
+        self._lock = threading.Lock()
+
+    def maybe_refresh(self, force: bool = False) -> ClusterMetadata:
+        with self._lock:
+            now = time.monotonic()
+            if force or now - self._last >= self._ttl_s:
+                fresh = cluster_metadata_from_kafka(self._client, self._exclude)
+                self._last = now
+                return self._md.refresh(fresh)
+            return self._md.cluster()

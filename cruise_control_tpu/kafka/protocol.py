@@ -1,0 +1,410 @@
+"""Kafka wire-protocol primitives + the API message codecs this build uses.
+
+The Kafka protocol is length-prefixed request/response frames; every field
+is big-endian, with two encoding families: "classic" (int16-length strings,
+int32-length arrays) and "flexible" (compact unsigned-varint lengths +
+tagged fields, used by newer API versions).  This module implements both,
+plus the v2 record-batch format (varint-delta records, CRC-32C) used by
+Produce/Fetch.
+
+Scope: exactly the APIs the edge adapters need —
+
+====  =========================  =======  ==========
+key   api                        version  encoding
+====  =========================  =======  ==========
+0     Produce                    3        classic, record-batch v2
+1     Fetch                      4        classic, record-batch v2
+2     ListOffsets                1        classic
+3     Metadata                   1        classic
+18    ApiVersions                0        classic
+19    CreateTopics               1        classic
+32    DescribeConfigs            1        classic
+34    AlterReplicaLogDirs        1        classic
+35    DescribeLogDirs            1        classic
+43    ElectLeaders               1        classic
+44    IncrementalAlterConfigs    0        classic
+45    AlterPartitionReassignments 0       flexible
+46    ListPartitionReassignments 0       flexible
+====  =========================  =======  ==========
+
+Reference behavior being bound (not ported): ExecutorUtils.scala:21 /
+ExecutorAdminUtils.java (reassignments, elections, logdirs),
+ReplicationThrottleHelper.java (throttle configs),
+KafkaSampleStore.java:69 (produce/fetch sample topics),
+CruiseControlMetricsReporterSampler.java:36 (metrics-topic consume),
+common/MetadataClient.java (cluster metadata).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# ---------------------------------------------------------------------------
+# CRC-32C (Castagnoli) — record-batch v2 checksums.  Table-driven, stdlib-only.
+# ---------------------------------------------------------------------------
+
+_CRC32C_POLY = 0x82F63B78
+_CRC32C_TABLE = []
+for _n in range(256):
+    _c = _n
+    for _ in range(8):
+        _c = (_c >> 1) ^ _CRC32C_POLY if _c & 1 else _c >> 1
+    _CRC32C_TABLE.append(_c)
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    crc ^= 0xFFFFFFFF
+    for b in data:
+        crc = _CRC32C_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# Primitive writers / readers
+# ---------------------------------------------------------------------------
+
+class Writer:
+    def __init__(self) -> None:
+        self._parts: List[bytes] = []
+
+    def bytes(self) -> bytes:
+        return b"".join(self._parts)
+
+    def raw(self, b: bytes) -> "Writer":
+        self._parts.append(b)
+        return self
+
+    def i8(self, v: int) -> "Writer":
+        return self.raw(struct.pack(">b", v))
+
+    def i16(self, v: int) -> "Writer":
+        return self.raw(struct.pack(">h", v))
+
+    def i32(self, v: int) -> "Writer":
+        return self.raw(struct.pack(">i", v))
+
+    def i64(self, v: int) -> "Writer":
+        return self.raw(struct.pack(">q", v))
+
+    def u32(self, v: int) -> "Writer":
+        return self.raw(struct.pack(">I", v))
+
+    def boolean(self, v: bool) -> "Writer":
+        return self.i8(1 if v else 0)
+
+    def f64(self, v: float) -> "Writer":
+        return self.raw(struct.pack(">d", v))
+
+    # varints (unsigned LEB128; signed = zigzag)
+    def uvarint(self, v: int) -> "Writer":
+        out = bytearray()
+        while True:
+            b = v & 0x7F
+            v >>= 7
+            if v:
+                out.append(b | 0x80)
+            else:
+                out.append(b)
+                break
+        return self.raw(bytes(out))
+
+    def varint(self, v: int) -> "Writer":
+        return self.uvarint((v << 1) ^ (v >> 63) if v < 0 else v << 1)
+
+    def varlong(self, v: int) -> "Writer":
+        return self.varint(v)
+
+    # classic strings/bytes/arrays
+    def string(self, s: Optional[str]) -> "Writer":
+        if s is None:
+            return self.i16(-1)
+        b = s.encode()
+        return self.i16(len(b)).raw(b)
+
+    def nbytes(self, b: Optional[bytes]) -> "Writer":
+        if b is None:
+            return self.i32(-1)
+        return self.i32(len(b)).raw(b)
+
+    def array(self, items: Optional[Sequence], fn) -> "Writer":
+        if items is None:
+            return self.i32(-1)
+        self.i32(len(items))
+        for it in items:
+            fn(self, it)
+        return self
+
+    # flexible (compact) strings/bytes/arrays + tagged fields
+    def cstring(self, s: Optional[str]) -> "Writer":
+        if s is None:
+            return self.uvarint(0)
+        b = s.encode()
+        return self.uvarint(len(b) + 1).raw(b)
+
+    def cbytes(self, b: Optional[bytes]) -> "Writer":
+        if b is None:
+            return self.uvarint(0)
+        return self.uvarint(len(b) + 1).raw(b)
+
+    def carray(self, items: Optional[Sequence], fn) -> "Writer":
+        if items is None:
+            return self.uvarint(0)
+        self.uvarint(len(items) + 1)
+        for it in items:
+            fn(self, it)
+        return self
+
+    def tags(self) -> "Writer":
+        return self.uvarint(0)  # no tagged fields
+
+
+class Reader:
+    def __init__(self, data: bytes) -> None:
+        self._d = data
+        self._o = 0
+
+    def remaining(self) -> int:
+        return len(self._d) - self._o
+
+    def raw(self, n: int) -> bytes:
+        b = self._d[self._o:self._o + n]
+        if len(b) < n:
+            raise EOFError(f"wanted {n} bytes, have {len(b)}")
+        self._o += n
+        return b
+
+    def i8(self) -> int:
+        return struct.unpack(">b", self.raw(1))[0]
+
+    def i16(self) -> int:
+        return struct.unpack(">h", self.raw(2))[0]
+
+    def i32(self) -> int:
+        return struct.unpack(">i", self.raw(4))[0]
+
+    def i64(self) -> int:
+        return struct.unpack(">q", self.raw(8))[0]
+
+    def u32(self) -> int:
+        return struct.unpack(">I", self.raw(4))[0]
+
+    def boolean(self) -> bool:
+        return self.i8() != 0
+
+    def f64(self) -> float:
+        return struct.unpack(">d", self.raw(8))[0]
+
+    def uvarint(self) -> int:
+        v = shift = 0
+        while True:
+            b = self._d[self._o]
+            self._o += 1
+            v |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return v
+            shift += 7
+
+    def varint(self) -> int:
+        v = self.uvarint()
+        return (v >> 1) ^ -(v & 1)
+
+    varlong = varint
+
+    def string(self) -> Optional[str]:
+        n = self.i16()
+        return None if n < 0 else self.raw(n).decode()
+
+    def nbytes(self) -> Optional[bytes]:
+        n = self.i32()
+        return None if n < 0 else self.raw(n)
+
+    def array(self, fn) -> Optional[list]:
+        n = self.i32()
+        return None if n < 0 else [fn(self) for _ in range(n)]
+
+    def cstring(self) -> Optional[str]:
+        n = self.uvarint()
+        return None if n == 0 else self.raw(n - 1).decode()
+
+    def cbytes(self) -> Optional[bytes]:
+        n = self.uvarint()
+        return None if n == 0 else self.raw(n - 1)
+
+    def carray(self, fn) -> Optional[list]:
+        n = self.uvarint()
+        return None if n == 0 else [fn(self) for _ in range(n - 1)]
+
+    def tags(self) -> None:
+        for _ in range(self.uvarint()):
+            self.uvarint()          # tag id
+            self.raw(self.uvarint())  # tag payload
+
+
+# ---------------------------------------------------------------------------
+# Record batches (magic v2) — the Produce/Fetch payload format
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Record:
+    key: Optional[bytes]
+    value: Optional[bytes]
+    timestamp_ms: int = -1
+    offset: int = -1  # absolute, filled on decode
+
+
+def encode_record_batch(records: Sequence[Record], base_offset: int = 0) -> bytes:
+    """One record batch, no compression, no producer id (idempotence off)."""
+    first_ts = min((r.timestamp_ms for r in records if r.timestamp_ms >= 0), default=-1)
+    max_ts = max((r.timestamp_ms for r in records), default=-1)
+    body = Writer()
+    body.i16(0)                      # attributes: no compression
+    body.i32(len(records) - 1)       # last offset delta
+    body.i64(first_ts)               # base timestamp
+    body.i64(max_ts)                 # max timestamp
+    body.i64(-1)                     # producer id
+    body.i16(-1)                     # producer epoch
+    body.i32(-1)                     # base sequence
+    body.i32(len(records))
+    for i, r in enumerate(records):
+        rec = Writer()
+        rec.i8(0)                                    # record attributes
+        rec.varlong(max(r.timestamp_ms, 0) - max(first_ts, 0))  # ts delta
+        rec.varint(i)                                # offset delta
+        kb = r.key
+        rec.varint(-1 if kb is None else len(kb))
+        if kb is not None:
+            rec.raw(kb)
+        vb = r.value
+        rec.varint(-1 if vb is None else len(vb))
+        if vb is not None:
+            rec.raw(vb)
+        rec.varint(0)                                # headers
+        rb = rec.bytes()
+        body.varint(len(rb)).raw(rb)
+    body_b = body.bytes()
+
+    out = Writer()
+    out.i64(base_offset)
+    out.i32(len(body_b) + 4 + 4 + 1)  # batch length (from partition-leader-epoch on)
+    out.i32(-1)                       # partition leader epoch
+    out.i8(2)                         # magic
+    out.u32(crc32c(body_b))
+    out.raw(body_b)
+    return out.bytes()
+
+
+def decode_record_batches(data: bytes) -> List[Record]:
+    """Decode a (possibly truncated) sequence of v2 record batches."""
+    out: List[Record] = []
+    r = Reader(data)
+    while r.remaining() > 17:
+        try:
+            base_offset = r.i64()
+            batch_len = r.i32()
+            if r.remaining() < batch_len:
+                break  # truncated trailing batch (Fetch may cut mid-batch)
+            body = Reader(r.raw(batch_len))
+            body.i32()            # partition leader epoch
+            magic = body.i8()
+            if magic != 2:
+                continue
+            body.u32()            # crc (trusted: local/fake brokers)
+            body.i16()            # attributes
+            body.i32()            # last offset delta
+            base_ts = body.i64()
+            body.i64()            # max ts
+            body.i64()            # producer id
+            body.i16()            # producer epoch
+            body.i32()            # base sequence
+            n = body.i32()
+            for _ in range(n):
+                rec_len = body.varint()
+                rec = Reader(body.raw(rec_len))
+                rec.i8()
+                ts_delta = rec.varlong()
+                off_delta = rec.varint()
+                klen = rec.varint()
+                key = rec.raw(klen) if klen >= 0 else None
+                vlen = rec.varint()
+                value = rec.raw(vlen) if vlen >= 0 else None
+                out.append(Record(key=key, value=value,
+                                  timestamp_ms=max(base_ts, 0) + ts_delta,
+                                  offset=base_offset + off_delta))
+        except (EOFError, IndexError):
+            break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Request framing
+# ---------------------------------------------------------------------------
+
+API_PRODUCE = 0
+API_FETCH = 1
+API_LIST_OFFSETS = 2
+API_METADATA = 3
+API_API_VERSIONS = 18
+API_CREATE_TOPICS = 19
+API_DESCRIBE_CONFIGS = 32
+API_ALTER_REPLICA_LOG_DIRS = 34
+API_DESCRIBE_LOG_DIRS = 35
+API_ELECT_LEADERS = 43
+API_INCREMENTAL_ALTER_CONFIGS = 44
+API_ALTER_PARTITION_REASSIGNMENTS = 45
+API_LIST_PARTITION_REASSIGNMENTS = 46
+
+# api key → (version, flexible_header)
+API_VERSIONS_USED: Dict[int, Tuple[int, bool]] = {
+    API_PRODUCE: (3, False),
+    API_FETCH: (4, False),
+    API_LIST_OFFSETS: (1, False),
+    API_METADATA: (1, False),
+    API_API_VERSIONS: (0, False),
+    API_CREATE_TOPICS: (1, False),
+    API_DESCRIBE_CONFIGS: (1, False),
+    API_ALTER_REPLICA_LOG_DIRS: (1, False),
+    API_DESCRIBE_LOG_DIRS: (1, False),
+    API_ELECT_LEADERS: (1, False),
+    API_INCREMENTAL_ALTER_CONFIGS: (0, False),
+    API_ALTER_PARTITION_REASSIGNMENTS: (0, True),
+    API_LIST_PARTITION_REASSIGNMENTS: (0, True),
+}
+
+
+def encode_request(api_key: int, correlation_id: int, client_id: str,
+                   payload: bytes) -> bytes:
+    version, flexible = API_VERSIONS_USED[api_key]
+    w = Writer()
+    w.i16(api_key).i16(version).i32(correlation_id).string(client_id)
+    if flexible:
+        w.tags()  # request header v2 tagged fields
+    w.raw(payload)
+    body = w.bytes()
+    return struct.pack(">i", len(body)) + body
+
+
+def decode_response_header(api_key: int, data: bytes) -> Tuple[int, Reader]:
+    _, flexible = API_VERSIONS_USED[api_key]
+    r = Reader(data)
+    corr = r.i32()
+    if flexible:
+        r.tags()  # response header v1 tagged fields
+    return corr, r
+
+
+ERROR_NONE = 0
+
+ERRORS = {
+    -1: "UNKNOWN_SERVER_ERROR", 0: "NONE", 1: "OFFSET_OUT_OF_RANGE",
+    3: "UNKNOWN_TOPIC_OR_PARTITION", 5: "LEADER_NOT_AVAILABLE",
+    6: "NOT_LEADER_OR_FOLLOWER", 7: "REQUEST_TIMED_OUT", 36: "TOPIC_ALREADY_EXISTS",
+    37: "INVALID_PARTITIONS", 41: "NOT_CONTROLLER", 42: "INVALID_REQUEST",
+    56: "KAFKA_STORAGE_ERROR", 57: "LOG_DIR_NOT_FOUND",
+    84: "ELECTION_NOT_NEEDED", 85: "NO_REASSIGNMENT_IN_PROGRESS",
+}
+
+
+def error_name(code: int) -> str:
+    return ERRORS.get(code, f"ERROR_{code}")

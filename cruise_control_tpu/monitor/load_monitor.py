@@ -203,26 +203,42 @@ class LoadMonitor:
                       pad_replicas_to: Optional[int] = None) -> TensorClusterModel:
         """Build the tensor cluster model from aggregated partition metrics +
         metadata + capacities (LoadMonitor.clusterModel, LoadMonitor.java:455)."""
+        return self.cluster_model_and_naming(requirements, allow_capacity_estimation,
+                                             pad_replicas_to)[0]
+
+    def cluster_model_and_naming(
+            self, requirements: Optional[ModelCompletenessRequirements] = None,
+            allow_capacity_estimation: bool = True,
+            pad_replicas_to: Optional[int] = None
+    ) -> Tuple[TensorClusterModel, Dict[str, object]]:
+        """Model + the dense-id↔name maps derived from the SAME metadata
+        snapshot.  Callers that later translate dense indices back to cluster
+        ids (proposal renumbering, executor requests) must use this naming,
+        not a fresh ``naming()`` read — membership can change mid-operation
+        and would silently misaddress every proposal."""
         req = requirements or ModelCompletenessRequirements()
         with self._model_semaphore:
+            cluster = self._metadata.cluster()
             if self.partition_aggregator.valid_windows() < req.min_required_num_windows:
                 raise NotEnoughValidWindowsError(
                     f"have {self.partition_aggregator.valid_windows()} valid windows, "
                     f"need {req.min_required_num_windows}")
             agg = self.partition_aggregator.aggregate()
             pct = 0.0
-            total = self._metadata.cluster().partition_count()
+            total = cluster.partition_count()
             if total:
                 pct = float(agg.entity_valid.sum()) / total
             if pct < req.min_monitored_partitions_percentage:
                 raise NotEnoughValidWindowsError(
                     f"monitored partition percentage {pct:.3f} below "
                     f"{req.min_monitored_partitions_percentage:.3f}")
-            return self._build_model(agg, allow_capacity_estimation, pad_replicas_to)
+            model = self._build_model(cluster, agg, allow_capacity_estimation,
+                                      pad_replicas_to)
+            return model, self.naming_for(cluster)
 
-    def _build_model(self, agg: AggregationResult, allow_capacity_estimation: bool,
+    def _build_model(self, cluster: ClusterMetadata, agg: AggregationResult,
+                     allow_capacity_estimation: bool,
                      pad_replicas_to: Optional[int]) -> TensorClusterModel:
-        cluster = self._metadata.cluster()
         # Row map from the aggregation snapshot itself (not the live aggregator),
         # so concurrently registered entities cannot index past the arrays.
         entity_rows = {e: i for i, e in enumerate(agg.entities)}
@@ -310,8 +326,13 @@ class LoadMonitor:
 
     # -- naming maps for the API layer ------------------------------------
     def naming(self) -> Dict[str, object]:
-        """Dense-id ↔ name maps the REST layer uses to render proposals."""
-        cluster = self._metadata.cluster()
+        """Dense-id ↔ name maps from the CURRENT metadata snapshot.  For
+        translating a model's dense indices use the naming returned by
+        ``cluster_model_and_naming`` (same snapshot as the model)."""
+        return self.naming_for(self._metadata.cluster())
+
+    @staticmethod
+    def naming_for(cluster: ClusterMetadata) -> Dict[str, object]:
         topics = cluster.topics()
         topic_id = {t: i for i, t in enumerate(topics)}
         parts = sorted(cluster.partitions,

@@ -94,15 +94,15 @@ def distributed_optimize_goal(model: TensorClusterModel, spec: GoalSpec,
                               max_steps: int = 256,
                               num_sources: Optional[int] = None,
                               num_dests: Optional[int] = None):
-    """Run one goal to fixpoint with mesh-sharded candidate scoring."""
+    """Run one goal to fixpoint with mesh-sharded candidate scoring.
+
+    Like the single-device path, the whole fixpoint is one device-resident
+    ``lax.while_loop`` dispatch (optimizer._goal_fixpoint); the mesh argument
+    makes GSPMD shard each step's candidate batch over the devices."""
+    from cruise_control_tpu.analyzer.optimizer import _get_fixpoint_fn
     ns = num_sources or cgen.default_num_sources(model)
     nd = num_dests or cgen.default_num_dests(model)
-    step = make_sharded_step(spec, prev_specs, constraint, ns, nd, mesh)
-    total = 0
-    for i in range(max_steps):
-        model, n = step(model, options)
-        n = int(n)
-        total += n
-        if n == 0:
-            return model, i + 1, total
-    return model, max_steps, total
+    fixpoint = _get_fixpoint_fn(spec, prev_specs, constraint, ns, nd, max_steps,
+                                mesh=mesh)
+    model, steps, total, _, _, _ = fixpoint(model, options)
+    return model, int(steps), int(total)

@@ -1,0 +1,20 @@
+"""Metrics-reporter module: the broker-side ingestion source.
+
+Equivalent of ``cruise-control-metrics-reporter`` (SURVEY.md §2.8): an agent
+that runs beside each Kafka broker, samples its raw metrics every interval,
+and produces versioned binary ``RawMetric`` records to the
+``__CruiseControlMetrics`` topic (CruiseControlMetricsReporter.java:60,88).
+The reference plugs into the broker JVM as a ``MetricsReporter``; a TPU-side
+Python framework cannot live inside the broker process, so the agent is a
+sidecar pulling from a pluggable ``BrokerMetricsSource`` (JMX-bridge, local
+stats, or synthetic for tests) with identical topic/serde semantics —
+everything downstream (sampler → processor → aggregator) is unchanged
+either way.
+"""
+
+from cruise_control_tpu.reporter.raw_metrics import (MetricScope, RawMetric,
+                                                     RawMetricType)
+from cruise_control_tpu.reporter.serde import decode_metric, encode_metric
+
+__all__ = ["MetricScope", "RawMetric", "RawMetricType", "decode_metric",
+           "encode_metric"]

@@ -1,0 +1,124 @@
+"""Raw broker/topic/partition metric types.
+
+Parity with ``RawMetricType`` (cruise-control-metrics-reporter/.../metric/
+RawMetricType.java:26): the ~50 raw metric ids the reporter emits, each
+scoped BROKER / TOPIC / PARTITION.  Ids here are this framework's own wire
+ids (serde is versioned independently of the reference's format).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, Optional
+
+
+class MetricScope(enum.IntEnum):
+    BROKER = 0
+    TOPIC = 1
+    PARTITION = 2
+
+
+class RawMetricType(enum.IntEnum):
+    # --- broker scope: totals over all topics ---
+    ALL_TOPIC_BYTES_IN = 0
+    ALL_TOPIC_BYTES_OUT = 1
+    ALL_TOPIC_REPLICATION_BYTES_IN = 2
+    ALL_TOPIC_REPLICATION_BYTES_OUT = 3
+    ALL_TOPIC_FETCH_REQUEST_RATE = 4
+    ALL_TOPIC_PRODUCE_REQUEST_RATE = 5
+    ALL_TOPIC_MESSAGES_IN_PER_SEC = 6
+    # --- broker scope: broker health ---
+    BROKER_CPU_UTIL = 7
+    BROKER_PRODUCE_REQUEST_RATE = 8
+    BROKER_CONSUMER_FETCH_REQUEST_RATE = 9
+    BROKER_FOLLOWER_FETCH_REQUEST_RATE = 10
+    BROKER_REQUEST_HANDLER_AVG_IDLE_PERCENT = 11
+    BROKER_REQUEST_QUEUE_SIZE = 12
+    BROKER_RESPONSE_QUEUE_SIZE = 13
+    BROKER_PRODUCE_REQUEST_QUEUE_TIME_MS_MAX = 14
+    BROKER_PRODUCE_REQUEST_QUEUE_TIME_MS_MEAN = 15
+    BROKER_PRODUCE_REQUEST_QUEUE_TIME_MS_50TH = 16
+    BROKER_PRODUCE_REQUEST_QUEUE_TIME_MS_999TH = 17
+    BROKER_CONSUMER_FETCH_REQUEST_QUEUE_TIME_MS_MAX = 18
+    BROKER_CONSUMER_FETCH_REQUEST_QUEUE_TIME_MS_MEAN = 19
+    BROKER_CONSUMER_FETCH_REQUEST_QUEUE_TIME_MS_50TH = 20
+    BROKER_CONSUMER_FETCH_REQUEST_QUEUE_TIME_MS_999TH = 21
+    BROKER_FOLLOWER_FETCH_REQUEST_QUEUE_TIME_MS_MAX = 22
+    BROKER_FOLLOWER_FETCH_REQUEST_QUEUE_TIME_MS_MEAN = 23
+    BROKER_FOLLOWER_FETCH_REQUEST_QUEUE_TIME_MS_50TH = 24
+    BROKER_FOLLOWER_FETCH_REQUEST_QUEUE_TIME_MS_999TH = 25
+    BROKER_PRODUCE_TOTAL_TIME_MS_MAX = 26
+    BROKER_PRODUCE_TOTAL_TIME_MS_MEAN = 27
+    BROKER_PRODUCE_TOTAL_TIME_MS_50TH = 28
+    BROKER_PRODUCE_TOTAL_TIME_MS_999TH = 29
+    BROKER_CONSUMER_FETCH_TOTAL_TIME_MS_MAX = 30
+    BROKER_CONSUMER_FETCH_TOTAL_TIME_MS_MEAN = 31
+    BROKER_CONSUMER_FETCH_TOTAL_TIME_MS_50TH = 32
+    BROKER_CONSUMER_FETCH_TOTAL_TIME_MS_999TH = 33
+    BROKER_FOLLOWER_FETCH_TOTAL_TIME_MS_MAX = 34
+    BROKER_FOLLOWER_FETCH_TOTAL_TIME_MS_MEAN = 35
+    BROKER_FOLLOWER_FETCH_TOTAL_TIME_MS_50TH = 36
+    BROKER_FOLLOWER_FETCH_TOTAL_TIME_MS_999TH = 37
+    BROKER_PRODUCE_LOCAL_TIME_MS_MAX = 38
+    BROKER_PRODUCE_LOCAL_TIME_MS_MEAN = 39
+    BROKER_PRODUCE_LOCAL_TIME_MS_50TH = 40
+    BROKER_PRODUCE_LOCAL_TIME_MS_999TH = 41
+    BROKER_CONSUMER_FETCH_LOCAL_TIME_MS_MAX = 42
+    BROKER_CONSUMER_FETCH_LOCAL_TIME_MS_MEAN = 43
+    BROKER_CONSUMER_FETCH_LOCAL_TIME_MS_50TH = 44
+    BROKER_CONSUMER_FETCH_LOCAL_TIME_MS_999TH = 45
+    BROKER_FOLLOWER_FETCH_LOCAL_TIME_MS_MAX = 46
+    BROKER_FOLLOWER_FETCH_LOCAL_TIME_MS_MEAN = 47
+    BROKER_FOLLOWER_FETCH_LOCAL_TIME_MS_50TH = 48
+    BROKER_FOLLOWER_FETCH_LOCAL_TIME_MS_999TH = 49
+    BROKER_LOG_FLUSH_RATE = 50
+    BROKER_LOG_FLUSH_TIME_MS_MAX = 51
+    BROKER_LOG_FLUSH_TIME_MS_MEAN = 52
+    BROKER_LOG_FLUSH_TIME_MS_50TH = 53
+    BROKER_LOG_FLUSH_TIME_MS_999TH = 54
+    # --- topic scope ---
+    TOPIC_BYTES_IN = 55
+    TOPIC_BYTES_OUT = 56
+    TOPIC_REPLICATION_BYTES_IN = 57
+    TOPIC_REPLICATION_BYTES_OUT = 58
+    TOPIC_FETCH_REQUEST_RATE = 59
+    TOPIC_PRODUCE_REQUEST_RATE = 60
+    TOPIC_MESSAGES_IN_PER_SEC = 61
+    # --- partition scope ---
+    PARTITION_SIZE = 62
+
+    @property
+    def scope(self) -> MetricScope:
+        if self >= RawMetricType.PARTITION_SIZE:
+            return MetricScope.PARTITION
+        if self >= RawMetricType.TOPIC_BYTES_IN:
+            return MetricScope.TOPIC
+        return MetricScope.BROKER
+
+
+@dataclasses.dataclass(frozen=True)
+class RawMetric:
+    """One raw metric record (CruiseControlMetric/BrokerMetric/TopicMetric/
+    PartitionMetric analogue)."""
+
+    metric_type: RawMetricType
+    time_ms: int
+    broker_id: int
+    value: float
+    topic: Optional[str] = None
+    partition: int = -1
+
+    def __post_init__(self):
+        scope = self.metric_type.scope
+        if scope != MetricScope.BROKER and self.topic is None:
+            raise ValueError(f"{self.metric_type.name} requires a topic")
+        if scope == MetricScope.PARTITION and self.partition < 0:
+            raise ValueError(f"{self.metric_type.name} requires a partition")
+
+
+def broker_metric_counts() -> Dict[MetricScope, int]:
+    out: Dict[MetricScope, int] = {s: 0 for s in MetricScope}
+    for t in RawMetricType:
+        out[t.scope] += 1
+    return out

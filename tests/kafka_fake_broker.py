@@ -1,0 +1,481 @@
+"""In-process fake Kafka broker speaking the wire protocol over TCP.
+
+The translation of the reference's embedded-broker integration harness
+(CCEmbeddedBroker / CCKafkaIntegrationTestHarness,
+cruise-control-metrics-reporter/src/test/java/.../utils/) for a JVM-free
+image: a real socket server implementing the same API subset the client
+speaks (tests exercise framing, correlation, varint/compact encodings, and
+record batches end-to-end), over an in-memory log.
+
+One TCP listener serves a whole virtual cluster: every virtual broker id
+advertises the same host:port, so leader-routed requests still land here.
+Reassignments complete lazily after ``reassignment_latency`` polls of
+ListPartitionReassignments — modelling Kafka's asynchronous data movement
+exactly like ``InMemoryClusterAdmin`` does for the in-memory path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from cruise_control_tpu.kafka import protocol as proto
+from cruise_control_tpu.kafka.protocol import Reader, Writer
+
+Tp = Tuple[str, int]
+
+
+@dataclasses.dataclass
+class FakePartition:
+    replicas: List[int]
+    leader: int
+    log: List[bytes] = dataclasses.field(default_factory=list)  # raw v2 batches
+    next_offset: int = 0
+    offsets: List[int] = dataclasses.field(default_factory=list)  # base offset per batch
+
+
+class FakeKafkaBroker:
+    def __init__(self, num_brokers: int = 3, reassignment_latency: int = 1,
+                 broker_ids: Optional[Sequence[int]] = None):
+        self.broker_ids = list(broker_ids or range(num_brokers))
+        self.racks = {b: f"rack{i % 3}" for i, b in enumerate(self.broker_ids)}
+        self.alive = {b: True for b in self.broker_ids}
+        self.topics: Dict[str, Dict[int, FakePartition]] = {}
+        self.configs: Dict[Tuple[int, str], Dict[str, str]] = {}
+        self.logdirs: Dict[int, List[str]] = {b: ["/d0", "/d1"]
+                                              for b in self.broker_ids}
+        self.logdir_moves: List[Tuple[Tp, int, str]] = []
+        self._latency = reassignment_latency
+        self._reassigning: Dict[Tp, Tuple[List[int], int]] = {}
+        self._lock = threading.RLock()
+        self._server: Optional[socketserver.ThreadingTCPServer] = None
+        self.host, self.port = "127.0.0.1", 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "FakeKafkaBroker":
+        broker = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:
+                self.request.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                try:
+                    while True:
+                        hdr = self._recv(4)
+                        if hdr is None:
+                            return
+                        (n,) = struct.unpack(">i", hdr)
+                        frame = self._recv(n)
+                        if frame is None:
+                            return
+                        resp = broker._handle_frame(frame)
+                        self.request.sendall(struct.pack(">i", len(resp)) + resp)
+                except (ConnectionError, OSError):
+                    return
+
+            def _recv(self, n: int) -> Optional[bytes]:
+                buf = bytearray()
+                while len(buf) < n:
+                    chunk = self.request.recv(n - len(buf))
+                    if not chunk:
+                        return None
+                    buf.extend(chunk)
+                return bytes(buf)
+
+        self._server = socketserver.ThreadingTCPServer(("127.0.0.1", 0), Handler)
+        self._server.daemon_threads = True
+        self.host, self.port = self._server.server_address
+        threading.Thread(target=self._server.serve_forever, daemon=True,
+                         name="fake-kafka").start()
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+
+    # -- cluster fixture helpers ------------------------------------------
+    def create_topic(self, name: str, partitions: int, rf: int = 1,
+                     assignment: Optional[Dict[int, Sequence[int]]] = None) -> None:
+        with self._lock:
+            parts: Dict[int, FakePartition] = {}
+            for p in range(partitions):
+                if assignment and p in assignment:
+                    reps = list(assignment[p])
+                else:
+                    reps = [self.broker_ids[(p + i) % len(self.broker_ids)]
+                            for i in range(rf)]
+                parts[p] = FakePartition(replicas=reps, leader=reps[0])
+            self.topics[name] = parts
+
+    def partition(self, tp: Tp) -> FakePartition:
+        return self.topics[tp[0]][tp[1]]
+
+    def kill_broker(self, broker_id: int) -> None:
+        with self._lock:
+            self.alive[broker_id] = False
+            for parts in self.topics.values():
+                for part in parts.values():
+                    if part.leader == broker_id:
+                        others = [b for b in part.replicas if self.alive.get(b)]
+                        part.leader = others[0] if others else -1
+
+    # -- dispatch ----------------------------------------------------------
+    def _handle_frame(self, frame: bytes) -> bytes:
+        r = Reader(frame)
+        api_key = r.i16()
+        version = r.i16()
+        corr = r.i32()
+        r.string()  # client id
+        _, flexible = proto.API_VERSIONS_USED.get(api_key, (0, False))
+        if flexible:
+            r.tags()
+        w = Writer()
+        w.i32(corr)
+        if flexible:
+            w.tags()
+        handler = {
+            proto.API_API_VERSIONS: self._api_versions,
+            proto.API_METADATA: self._metadata,
+            proto.API_PRODUCE: self._produce,
+            proto.API_FETCH: self._fetch,
+            proto.API_LIST_OFFSETS: self._list_offsets,
+            proto.API_CREATE_TOPICS: self._create_topics,
+            proto.API_DESCRIBE_CONFIGS: self._describe_configs,
+            proto.API_INCREMENTAL_ALTER_CONFIGS: self._incr_alter_configs,
+            proto.API_ALTER_PARTITION_REASSIGNMENTS: self._alter_reassignments,
+            proto.API_LIST_PARTITION_REASSIGNMENTS: self._list_reassignments,
+            proto.API_ELECT_LEADERS: self._elect_leaders,
+            proto.API_DESCRIBE_LOG_DIRS: self._describe_logdirs,
+            proto.API_ALTER_REPLICA_LOG_DIRS: self._alter_replica_logdirs,
+        }[api_key]
+        with self._lock:
+            handler(r, w, version)
+        return w.bytes()
+
+    # -- handlers ----------------------------------------------------------
+    def _api_versions(self, r: Reader, w: Writer, v: int) -> None:
+        w.i16(0)
+        w.array(sorted(proto.API_VERSIONS_USED),
+                lambda wr, k: wr.i16(k).i16(0).i16(proto.API_VERSIONS_USED[k][0]))
+
+    def _metadata(self, r: Reader, w: Writer, v: int) -> None:
+        r.array(lambda rr: rr.string())
+        w.array([b for b in self.broker_ids],
+                lambda wr, b: wr.i32(b).string(self.host).i32(self.port)
+                .string(self.racks[b]))
+        w.i32(self.broker_ids[0])  # controller
+        def topic_fn(wr: Writer, name: str):
+            wr.i16(0).string(name).boolean(False)
+            parts = self.topics[name]
+            def part_fn(wp: Writer, pid: int):
+                part = parts[pid]
+                wp.i16(0).i32(pid).i32(part.leader)
+                wp.array(part.replicas, lambda wx, b: wx.i32(b))
+                alive_isr = [b for b in part.replicas if self.alive.get(b)]
+                wp.array(alive_isr, lambda wx, b: wx.i32(b))
+            wr.array(sorted(parts), part_fn)
+        w.array(sorted(self.topics), topic_fn)
+
+    def _produce(self, r: Reader, w: Writer, v: int) -> None:
+        r.string()  # txn id
+        r.i16()     # acks
+        r.i32()     # timeout
+        results: List[Tuple[str, int, int, int]] = []
+
+        def topic_fn(rr: Reader):
+            t = rr.string()
+            def part_fn(pr: Reader):
+                pid = pr.i32()
+                data = pr.nbytes()
+                part = self.topics.get(t, {}).get(pid)
+                if part is None:
+                    results.append((t, pid, 3, -1))
+                    return
+                recs = proto.decode_record_batches(data)
+                base = part.next_offset
+                # Re-encode with the assigned base offset so fetches return
+                # correct absolute offsets.
+                rebased = proto.encode_record_batch(recs, base_offset=base)
+                part.log.append(rebased)
+                part.offsets.append(base)
+                part.next_offset = base + len(recs)
+                results.append((t, pid, 0, base))
+            rr.array(part_fn)
+        r.array(topic_fn)
+        by_topic: Dict[str, List[Tuple[int, int, int]]] = {}
+        for t, pid, err, off in results:
+            by_topic.setdefault(t, []).append((pid, err, off))
+        def topic_resp(wr: Writer, t: str):
+            wr.string(t)
+            wr.array(by_topic[t],
+                     lambda wp, x: wp.i32(x[0]).i16(x[1]).i64(x[2]).i64(-1))
+        w.array(sorted(by_topic), topic_resp)
+        w.i32(0)  # throttle
+
+    def _fetch(self, r: Reader, w: Writer, v: int) -> None:
+        r.i32(); r.i32(); r.i32(); r.i32(); r.i8()
+        wants: List[Tuple[str, int, int]] = []
+
+        def topic_fn(rr: Reader):
+            t = rr.string()
+            rr.array(lambda pr: wants.append((t, pr.i32(), pr.i64()))
+                     or pr.i32())
+        r.array(topic_fn)
+        w.i32(0)  # throttle
+        by_topic: Dict[str, List[Tuple[int, int]]] = {}
+        for t, pid, off in wants:
+            by_topic.setdefault(t, []).append((pid, off))
+        def topic_resp(wr: Writer, t: str):
+            wr.string(t)
+            def part_resp(wp: Writer, item):
+                pid, off = item
+                part = self.topics.get(t, {}).get(pid)
+                if part is None:
+                    wp.i32(pid).i16(3).i64(-1).i64(-1)
+                    wp.array([], lambda *_: None)
+                    wp.nbytes(None)
+                    return
+                # All batches whose base offset + count > requested offset.
+                chunks = [b for b, base in zip(part.log, part.offsets)
+                          if base + 1_000_000_000 > off]
+                data = b"".join(b for b, base in zip(part.log, part.offsets))
+                wp.i32(pid).i16(0).i64(part.next_offset).i64(part.next_offset)
+                wp.array([], lambda *_: None)  # aborted txns
+                wp.nbytes(data if off < part.next_offset else b"")
+            wr.array(by_topic[t], part_resp)
+        w.array(sorted(by_topic), topic_resp)
+
+    def _list_offsets(self, r: Reader, w: Writer, v: int) -> None:
+        r.i32()
+        wants: List[Tuple[str, int, int]] = []
+
+        def topic_fn(rr: Reader):
+            t = rr.string()
+            rr.array(lambda pr: wants.append((t, pr.i32(), pr.i64())))
+        r.array(topic_fn)
+        by_topic: Dict[str, List[Tuple[int, int]]] = {}
+        for t, pid, ts in wants:
+            by_topic.setdefault(t, []).append((pid, ts))
+        def topic_resp(wr: Writer, t: str):
+            wr.string(t)
+            def part_resp(wp: Writer, item):
+                pid, ts = item
+                part = self.topics.get(t, {}).get(pid)
+                if part is None:
+                    wp.i32(pid).i16(3).i64(-1).i64(-1)
+                    return
+                off = 0 if ts == -2 else part.next_offset
+                wp.i32(pid).i16(0).i64(-1).i64(off)
+            wr.array(by_topic[t], part_resp)
+        w.array(sorted(by_topic), topic_resp)
+
+    def _create_topics(self, r: Reader, w: Writer, v: int) -> None:
+        results: List[Tuple[str, int]] = []
+
+        def topic_fn(rr: Reader):
+            name = rr.string()
+            nparts = rr.i32()
+            rf = rr.i16()
+            rr.array(lambda ar: (ar.i32(), ar.array(lambda x: x.i32())))
+            cfgs = rr.array(lambda cr: (cr.string(), cr.string())) or []
+            if name in self.topics:
+                results.append((name, 36))
+            else:
+                self.create_topic(name, max(nparts, 1), max(rf, 1))
+                self.configs[(2, name)] = dict(cfgs)
+                results.append((name, 0))
+        r.array(topic_fn)
+        r.i32()
+        r.boolean()
+        w.array(results, lambda wr, x: wr.string(x[0]).i16(x[1]).string(None))
+
+    def _describe_configs(self, r: Reader, w: Writer, v: int) -> None:
+        wants: List[Tuple[int, str]] = []
+
+        def res_fn(rr: Reader):
+            rtype = rr.i8()
+            rname = rr.string()
+            rr.array(lambda x: x.string())
+            wants.append((rtype, rname))
+        r.array(res_fn)
+        r.boolean()
+        w.i32(0)  # throttle
+        def resp(wr: Writer, item):
+            rtype, rname = item
+            cfg = self.configs.get((rtype, rname), {})
+            wr.i16(0).string(None).i8(rtype).string(rname)
+            def entry(we: Writer, kv):
+                we.string(kv[0]).string(kv[1]).boolean(False).i8(5).boolean(False)
+                we.array([], lambda *_: None)
+            wr.array(sorted(cfg.items()), entry)
+        w.array(wants, resp)
+
+    def _incr_alter_configs(self, r: Reader, w: Writer, v: int) -> None:
+        results: List[Tuple[int, str]] = []
+
+        def res_fn(rr: Reader):
+            rtype = rr.i8()
+            rname = rr.string()
+            def cfg_fn(cr: Reader):
+                key = cr.string()
+                op = cr.i8()
+                val = cr.string()
+                cfg = self.configs.setdefault((rtype, rname), {})
+                if op == 0:
+                    cfg[key] = val or ""
+                elif op == 1:
+                    cfg.pop(key, None)
+                elif op == 2:  # append to list value
+                    cur = [x for x in cfg.get(key, "").split(",") if x]
+                    for add in (val or "").split(","):
+                        if add and add not in cur:
+                            cur.append(add)
+                    cfg[key] = ",".join(cur)
+                elif op == 3:  # subtract from list value
+                    cur = [x for x in cfg.get(key, "").split(",") if x]
+                    gone = set((val or "").split(","))
+                    cfg[key] = ",".join(x for x in cur if x not in gone)
+            rr.array(cfg_fn)
+            results.append((rtype, rname))
+        r.array(res_fn)
+        r.boolean()
+        w.i32(0)
+        w.array(results, lambda wr, x: wr.i16(0).string(None).i8(x[0]).string(x[1]))
+
+    def _alter_reassignments(self, r: Reader, w: Writer, v: int) -> None:
+        r.i32()  # timeout
+        results: List[Tuple[str, int, int]] = []
+
+        def topic_fn(rr: Reader):
+            t = rr.cstring()
+            def part_fn(pr: Reader):
+                pid = pr.i32()
+                reps = pr.carray(lambda x: x.i32())
+                pr.tags()
+                part = self.topics.get(t, {}).get(pid)
+                if part is None:
+                    results.append((t, pid, 3))
+                elif reps is None:
+                    self._reassigning.pop((t, pid), None)
+                    results.append((t, pid, 0))
+                else:
+                    self._reassigning[(t, pid)] = (list(reps), self._latency)
+                    results.append((t, pid, 0))
+            rr.carray(part_fn)
+            rr.tags()
+        r.carray(topic_fn)
+        r.tags()
+        w.i32(0).i16(0).cstring(None)
+        by_topic: Dict[str, List[Tuple[int, int]]] = {}
+        for t, pid, err in results:
+            by_topic.setdefault(t, []).append((pid, err))
+        def topic_resp(wr: Writer, t: str):
+            wr.cstring(t)
+            wr.carray(by_topic[t],
+                      lambda wp, x: wp.i32(x[0]).i16(x[1]).cstring(None).tags())
+            wr.tags()
+        w.carray(sorted(by_topic), topic_resp)
+        w.tags()
+
+    def _advance_reassignments(self) -> None:
+        done = []
+        for tp, (reps, remaining) in list(self._reassigning.items()):
+            if remaining <= 0:
+                part = self.topics[tp[0]][tp[1]]
+                part.replicas = list(reps)
+                if part.leader not in reps:
+                    part.leader = reps[0]
+                done.append(tp)
+            else:
+                self._reassigning[tp] = (reps, remaining - 1)
+        for tp in done:
+            del self._reassigning[tp]
+
+    def _list_reassignments(self, r: Reader, w: Writer, v: int) -> None:
+        r.i32()
+        r.carray(lambda rr: (rr.cstring(), rr.carray(lambda x: x.i32()), rr.tags()))
+        r.tags()
+        self._advance_reassignments()
+        w.i32(0).i16(0).cstring(None)
+        by_topic: Dict[str, List[Tuple[int, List[int]]]] = {}
+        for (t, pid), (reps, _) in self._reassigning.items():
+            by_topic.setdefault(t, []).append((pid, reps))
+        def topic_resp(wr: Writer, t: str):
+            wr.cstring(t)
+            def part_resp(wp: Writer, item):
+                pid, reps = item
+                cur = self.topics[t][pid].replicas
+                wp.i32(pid)
+                wp.carray(sorted(set(cur) | set(reps)), lambda wx, b: wx.i32(b))
+                wp.carray([b for b in reps if b not in cur], lambda wx, b: wx.i32(b))
+                wp.carray([b for b in cur if b not in reps], lambda wx, b: wx.i32(b))
+                wp.tags()
+            wr.carray(by_topic[t], part_resp)
+            wr.tags()
+        w.carray(sorted(by_topic), topic_resp)
+        w.tags()
+
+    def _elect_leaders(self, r: Reader, w: Writer, v: int) -> None:
+        if v >= 1:
+            r.i8()  # election type
+        wants: List[Tp] = []
+
+        def topic_fn(rr: Reader):
+            t = rr.string()
+            rr.array(lambda pr: wants.append((t, pr.i32())))
+        r.array(topic_fn)
+        r.i32()
+        results: List[Tuple[str, int, int]] = []
+        for t, pid in wants:
+            part = self.topics.get(t, {}).get(pid)
+            if part is None:
+                results.append((t, pid, 3))
+            else:
+                part.leader = part.replicas[0]
+                results.append((t, pid, 0))
+        w.i32(0).i16(0)
+        by_topic: Dict[str, List[Tuple[int, int]]] = {}
+        for t, pid, err in results:
+            by_topic.setdefault(t, []).append((pid, err))
+        def topic_resp(wr: Writer, t: str):
+            wr.string(t)
+            wr.array(by_topic[t], lambda wp, x: wp.i32(x[0]).i16(x[1]).string(None))
+        w.array(sorted(by_topic), topic_resp)
+
+    def _describe_logdirs(self, r: Reader, w: Writer, v: int) -> None:
+        r.array(lambda rr: (rr.string(), rr.array(lambda x: x.i32())))
+        w.i32(0)
+        # This fake cannot know which virtual broker the client meant (all
+        # ids share one socket), so it reports the union view: every logdir
+        # of every broker.  Fine for DiskFailureDetector-style liveness use.
+        dirs = sorted({d for ds in self.logdirs.values() for d in ds})
+        def dir_fn(wr: Writer, path: str):
+            wr.i16(0).string(path)
+            wr.array([], lambda *_: None)
+        w.array(dirs, dir_fn)
+
+    def _alter_replica_logdirs(self, r: Reader, w: Writer, v: int) -> None:
+        results: List[Tuple[str, int, int]] = []
+
+        def dir_fn(rr: Reader):
+            path = rr.string()
+            def topic_fn(tr: Reader):
+                t = tr.string()
+                def part_fn(pr: Reader):
+                    pid = pr.i32()
+                    self.logdir_moves.append(((t, pid), -1, path))
+                    results.append((t, pid, 0))
+                tr.array(part_fn)
+            rr.array(topic_fn)
+        r.array(dir_fn)
+        by_topic: Dict[str, List[Tuple[int, int]]] = {}
+        for t, pid, err in results:
+            by_topic.setdefault(t, []).append((pid, err))
+        def topic_resp(wr: Writer, t: str):
+            wr.string(t)
+            wr.array(by_topic[t], lambda wp, x: wp.i32(x[0]).i16(x[1]))
+        w.array(sorted(by_topic), topic_resp)

@@ -25,16 +25,18 @@ from cruise_control_tpu.monitor.sampling import SyntheticWorkloadSampler
 W = 300_000
 
 
-def build_stack(num_brokers=5, two_step=False, security=None):
+def build_stack(num_brokers=5, two_step=False, security=None, broker_ids=None):
     rng = np.random.default_rng(19)
-    brokers = tuple(BrokerInfo(i, rack=f"r{i % 3}", host=f"h{i}")
-                    for i in range(num_brokers))
+    ids = list(broker_ids) if broker_ids else list(range(num_brokers))
+    num_brokers = len(ids)
+    brokers = tuple(BrokerInfo(b, rack=f"r{i % 3}", host=f"h{i}")
+                    for i, b in enumerate(ids))
     w = np.linspace(1, 4, num_brokers)
     w /= w.sum()
     parts = []
     for t in range(3):
         for p in range(8):
-            reps = tuple(int(x) for x in
+            reps = tuple(ids[int(x)] for x in
                          rng.choice(num_brokers, 2, replace=False, p=w))
             parts.append(PartitionInfo(f"t{t}", p, leader=reps[0], replicas=reps))
     mc = MetadataClient(ClusterMetadata(brokers=brokers, partitions=tuple(parts)))
@@ -113,6 +115,75 @@ def test_remove_broker_via_api():
     assert s == 200 and body["ok"]
     assert not any(4 in p.replicas for p in mc.cluster().partitions)
     assert 4 in cc.executor.recently_removed_brokers()
+
+
+def test_noncontiguous_broker_ids_rebalance_and_remove():
+    """Cluster ids ≠ dense model indices: proposals/executions must carry the
+    real broker ids (round-1 advisory: dense indices leaked to the executor)."""
+    ids = [10, 25, 31, 47, 52]
+    api, cc, mc = build_stack(broker_ids=ids)
+    s, dry, _ = api.handle("POST", "rebalance", {"max_wait_s": "300"})
+    assert s == 200 and dry["numProposals"] > 0
+    seen = {b for p in dry["proposals"] for b in p["newReplicas"]}
+    assert seen <= set(ids)  # real cluster ids, not 0..4
+    s, wet, _ = api.handle("POST", "rebalance",
+                           {"dryrun": "false", "max_wait_s": "300"})
+    assert s == 200 and wet["ok"] and wet["execution"]["completed"] > 0
+    for p in mc.cluster().partitions:
+        assert set(p.replicas) <= set(ids)
+    # Remove a broker by its real id.
+    s, body, _ = api.handle("POST", "remove_broker",
+                            {"brokerid": "52", "dryrun": "false",
+                             "max_wait_s": "300"})
+    assert s == 200 and body["ok"]
+    assert not any(52 in p.replicas for p in mc.cluster().partitions)
+    assert 52 in cc.executor.recently_removed_brokers()
+
+
+def test_demote_moves_all_leadership_off_broker():
+    """Demotion must transfer every leader off the demoted broker even when
+    its leader count is inside the balance band (round-1 advisory: demote
+    could silently no-op)."""
+    ids = [7, 11, 13, 19, 23]
+    api, cc, mc = build_stack(broker_ids=ids)
+    victim = 11
+    assert any(p.leader == victim for p in mc.cluster().partitions)
+    s, body, _ = api.handle("POST", "demote_broker",
+                            {"brokerid": str(victim), "dryrun": "false",
+                             "max_wait_s": "300"})
+    assert s == 200 and body["ok"], body
+    assert not any(p.leader == victim for p in mc.cluster().partitions)
+    # Replicas stay (demote moves leadership, not replicas).
+    assert any(victim in p.replicas for p in mc.cluster().partitions)
+    assert victim in cc.executor.recently_demoted_brokers()
+
+
+def test_demote_succeeds_with_unmovable_rf1_leader():
+    """An RF=1 partition's leadership cannot move; demote must still succeed
+    after transferring all movable leadership (DemoteBrokerRunnable parity)."""
+    rng = np.random.default_rng(3)
+    ids = [0, 1, 2, 3, 4]
+    brokers = tuple(BrokerInfo(b, rack=f"r{b % 3}", host=f"h{b}") for b in ids)
+    parts = [PartitionInfo("solo", 0, leader=2, replicas=(2,))]  # RF=1 on victim
+    for t in range(2):
+        for p in range(8):
+            reps = tuple(int(x) for x in rng.choice(5, 2, replace=False))
+            parts.append(PartitionInfo(f"t{t}", p, leader=reps[0], replicas=reps))
+    mc = MetadataClient(ClusterMetadata(brokers=brokers, partitions=tuple(parts)))
+    lm = LoadMonitor(mc, StaticCapacityResolver(), num_partition_windows=3,
+                     partition_window_ms=W)
+    lm.start_up()
+    sampler = SyntheticWorkloadSampler()
+    for wdx in range(4):
+        lm.fetch_once(sampler, wdx * W, wdx * W + 1)
+    admin = InMemoryClusterAdmin(mc, latency_polls=1)
+    ex = Executor(admin, mc)
+    cc = CruiseControl(lm, ex, admin)
+    ok = cc.demote_brokers([2], dryrun=False)
+    assert ok
+    # Movable leaders gone; the RF=1 leader necessarily stays.
+    leaders_on_2 = [p.tp for p in mc.cluster().partitions if p.leader == 2]
+    assert leaders_on_2 == [("solo", 0)]
 
 
 def test_topic_configuration_rf_change():
