@@ -1,0 +1,47 @@
+"""Honest gather/scatter cost on this TPU: indices depend on loop counter."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+R, B, P, K = 10240, 56, 3400, 20800
+N = 300
+key = jax.random.PRNGKey(0)
+vals = jax.random.normal(key, (R,))
+vals4 = jax.random.normal(key, (R, 4))
+idx = jax.random.randint(key, (R,), 0, R)
+seg_p = jax.random.randint(key, (R,), 0, P)
+seg_b = jax.random.randint(key, (R,), 0, B)
+kidx = jax.random.randint(key, (K,), 0, R)
+
+
+def timeit(name, body, init=0.0):
+    def fn():
+        def it(i, acc):
+            return body(i, acc)
+        return jax.lax.fori_loop(0, N, it, jnp.float32(init))
+    f = jax.jit(fn)
+    out = f(); jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = f(); jax.block_until_ready(out)
+    print(f"{name}: {(time.perf_counter() - t0) / N * 1e3:.4f} ms/iter")
+
+
+timeit("noop", lambda i, acc: acc + 1.0)
+timeit("gather R", lambda i, acc: acc + vals[(idx + i) % R].sum())
+timeit("gather K from R", lambda i, acc: acc + vals[(kidx + i) % R].sum())
+timeit("scatter-add R->P", lambda i, acc: acc + jnp.zeros((P,)).at[
+    (seg_p + i) % P].add(vals).sum())
+timeit("scatter-add R->B", lambda i, acc: acc + jnp.zeros((B,)).at[
+    (seg_b + i) % B].add(vals).sum())
+timeit("scatter-add R->B [R,4]", lambda i, acc: acc + jnp.zeros((B, 4)).at[
+    (seg_b + i) % B].add(vals4).sum())
+timeit("onehot-mm R->B [R,4]", lambda i, acc: acc + (
+    jax.nn.one_hot((seg_b + i) % B, B, dtype=jnp.float32).T @ vals4).sum())
+timeit("onehot-mm R->P", lambda i, acc: acc + (
+    jax.nn.one_hot((seg_p + i) % P, P, dtype=jnp.float32).T @ vals).sum())
+timeit("elementwise R chain", lambda i, acc: acc + (
+    jnp.sin(vals + acc) * 2.0 + 1.0).sum())
+timeit("top_k R 400", lambda i, acc: acc + jax.lax.top_k(
+    vals + acc, 400)[0].sum())
+timeit("sort R", lambda i, acc: acc + jnp.sort(vals + acc)[0])

@@ -1,0 +1,108 @@
+"""Microbench: scatter-based segment ops vs one-hot matmul on TPU.
+
+Times N iterations INSIDE one jit (fori_loop with a data dependency) so
+tunnel/dispatch overhead is excluded.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+R, B, K, P = 10240, 56, 20800, 3400
+N = 200
+
+key = jax.random.PRNGKey(0)
+vals = jax.random.normal(key, (R, 4))
+seg = jax.random.randint(key, (R,), 0, B)
+mask = jnp.ones((R,), bool)
+score = jax.random.normal(key, (K,))
+kseg = jax.random.randint(key, (K,), 0, B)
+pseg = jax.random.randint(key, (K,), 0, P)
+elig = jax.random.bernoulli(key, 0.3, (K,))
+
+
+def timeit(name, fn, *args):
+    f = jax.jit(fn)
+    out = f(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = f(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / N * 1e6
+    print(f"{name}: {dt:.1f} us/iter")
+
+
+def loop(body):
+    def fn(*args):
+        def it(i, carry):
+            return body(*args, carry)
+        return jax.lax.fori_loop(0, N, it, jnp.zeros((B,)))
+    return fn
+
+
+# 1) scatter segment-sum R->B
+timeit("scatter_segsum R->B", loop(
+    lambda v, s, c: jnp.zeros((B, 4)).at[s].add(v + c[0]).sum(axis=1)), vals, seg)
+
+# 2) one-hot matmul segment-sum R->B
+def onehot_segsum(v, s, c):
+    oh = jax.nn.one_hot(s, B, dtype=v.dtype)  # [R, B]
+    return (oh.T @ (v + c[0])).sum(axis=1)
+timeit("onehot_segsum R->B", loop(onehot_segsum), vals, seg)
+
+# 3) scatter best-per-segment K->B (max + argwinner like _best_per_segment)
+def best_scatter(sc, ks, e, c):
+    masked = jnp.where(e, sc + c[0], -jnp.inf)
+    best = jnp.full((B,), -jnp.inf).at[ks].max(masked)
+    is_best = e & (masked >= best[ks]) & jnp.isfinite(masked)
+    idx = jnp.arange(K, dtype=jnp.int32)
+    winner = jnp.full((B,), K, jnp.int32).at[ks].min(jnp.where(is_best, idx, K))
+    return (is_best & (idx == winner[ks])).sum() + jnp.zeros((B,))
+timeit("best_per_seg scatter K->B", best_scatter and loop(best_scatter), score, kseg, elig)
+
+# 4) dense-argmax best-per-segment K->B via [B, K] masked broadcast
+def best_dense(sc, ks, e, c):
+    masked = jnp.where(e, sc + c[0], -jnp.inf)
+    oh = ks[None, :] == jnp.arange(B)[:, None]          # [B, K] bool
+    m = jnp.where(oh, masked[None, :], -jnp.inf)        # [B, K]
+    win = jnp.argmax(m, axis=1)                          # [B]
+    has = jnp.isfinite(jnp.max(m, axis=1))
+    keep = jnp.zeros((K,), bool).at[win].set(has)
+    return keep.sum() + jnp.zeros((B,))
+timeit("best_per_seg dense K->B", loop(best_dense), score, kseg, elig)
+
+# 5) scatter best-per-segment K->P (partitions)
+def best_scatter_p(sc, ps, e, c):
+    masked = jnp.where(e, sc + c[0], -jnp.inf)
+    best = jnp.full((P,), -jnp.inf).at[ps].max(masked)
+    is_best = e & (masked >= best[ps]) & jnp.isfinite(masked)
+    idx = jnp.arange(K, dtype=jnp.int32)
+    winner = jnp.full((P,), K, jnp.int32).at[ps].min(jnp.where(is_best, idx, K))
+    return (is_best & (idx == winner[ps])).sum() + jnp.zeros((B,))
+timeit("best_per_seg scatter K->P", loop(best_scatter_p), score, pseg, elig)
+
+# 6) top_k over R
+def topk(v, c):
+    _, i = jax.lax.top_k(v[:, 0] + c[0], 400)
+    return jnp.zeros((B,)) + i.sum()
+timeit("top_k R->400", loop(topk), vals)
+
+# 7) gather K from R
+gidx = jax.random.randint(key, (K,), 0, R)
+def gath(v, g, c):
+    return jnp.zeros((B,)).at[0].set(v[g, 0].sum() + c[0])
+timeit("gather K from R", loop(gath), vals, gidx)
+
+# 8) scatter-add K->B with [K,8] payload (cum budgets)
+pay = jax.random.normal(key, (K, 8))
+def cum(p_, ks, e, c):
+    return jnp.zeros((B, 8)).at[jnp.where(e, ks, 0)].add(
+        jnp.where(e[:, None], p_ + c[0], 0.0)).sum(axis=1)
+timeit("scatter_add K->B [K,8]", loop(cum), pay, kseg, elig)
+
+# 9) one-hot matmul K->B [K,8]
+def cum_mm(p_, ks, e, c):
+    oh = jax.nn.one_hot(jnp.where(e, ks, B), B + 1, dtype=p_.dtype)[:, :B]
+    return (oh.T @ (p_ + c[0])).sum(axis=1)
+timeit("onehot matmul K->B [K,8]", loop(cum_mm), pay, kseg, elig)

@@ -1,0 +1,65 @@
+"""Bisect the rack kernel cost."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer import candidates as cgen
+from cruise_control_tpu.analyzer.balancing_constraint import BalancingConstraint
+from cruise_control_tpu.analyzer.goals import kernels
+from cruise_control_tpu.analyzer.goals.specs import GOAL_SPECS
+from cruise_control_tpu.analyzer.state import BrokerArrays, OptimizationOptions
+from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster
+
+spec = ClusterSpec(num_brokers=50, num_racks=10, num_topics=40,
+                   mean_partitions_per_topic=84.0, replication_factor=3,
+                   distribution="exponential", seed=2026)
+model = generate_cluster(spec)
+options = OptimizationOptions.none(model)
+con = BalancingConstraint.default()
+ns, nd = cgen.default_num_sources(model), cgen.default_num_dests(model)
+g = GOAL_SPECS["RackAwareGoal"]
+N = 100
+
+
+def timed(name, body):
+    def outer(m):
+        arrays = BrokerArrays.from_model(m)
+        cand = cgen.move_candidates(g, m, arrays, con, options, ns, nd)
+        def it(i, acc):
+            return acc + body(m, arrays, cand, acc)
+        return jax.lax.fori_loop(0, N, it, jnp.float32(0))
+    f = jax.jit(outer)
+    out = f(model)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = f(model)
+    jax.block_until_ready(out)
+    print(f"{name}: {(time.perf_counter() - t0) / N * 1000:.3f} ms/iter")
+
+
+def wiggle(m, acc):
+    # tiny carry-dependent perturbation to defeat loop hoisting
+    return m.replace(replica_broker=m.replica_broker + (acc.astype(jnp.int32) * 0))
+
+timed("baseline (noop)", lambda m, a, c, acc: jnp.float32(0))
+timed("conflict[R]", lambda m, a, c, acc: kernels._replica_rack_conflict(
+    g, wiggle(m, acc)).sum().astype(jnp.float32))
+timed("move_rack_ok[K]", lambda m, a, c, acc: kernels._move_rack_ok(
+    g, wiggle(m, acc), c).sum().astype(jnp.float32))
+timed("score rack", lambda m, a, c, acc: kernels.score(
+    g, wiggle(m, acc), a, c, con).sum())
+timed("self_feasible rack", lambda m, a, c, acc: kernels.self_feasible(
+    g, wiggle(m, acc), a, c, con).sum().astype(jnp.float32))
+timed("accepts rack", lambda m, a, c, acc: kernels.accepts(
+    g, wiggle(m, acc), a, c, con).sum().astype(jnp.float32))
+timed("relevance rack[R]", lambda m, a, c, acc: kernels.source_replica_relevance(
+    g, wiggle(m, acc), a, con).sum())
+timed("offline_now[R]", lambda m, a, c, acc: wiggle(m, acc).replica_offline_now()
+      .sum().astype(jnp.float32))
+timed("move_candidates", lambda m, a, c, acc: cgen.move_candidates(
+    g, wiggle(m, acc), a, con, options, ns, nd).valid.sum().astype(jnp.float32))
+timed("partition_rf[P]", lambda m, a, c, acc: wiggle(m, acc)
+      .partition_replication_factor().sum().astype(jnp.float32))
+timed("legit_move[K]", lambda m, a, c, acc: cgen._legit_move_mask(
+    wiggle(m, acc), a, options, c.replica, c.dest).sum().astype(jnp.float32))

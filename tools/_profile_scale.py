@@ -1,0 +1,39 @@
+import time
+import jax
+import jax.numpy as jnp
+
+N = 300
+key = jax.random.PRNGKey(0)
+for R in (1024, 10240, 102400, 1024000, 4096000):
+    vals = jax.random.normal(key, (R,))
+    def fn():
+        def it(i, acc):
+            return acc + (jnp.sin(vals + acc) * 2.0 + 1.0).sum()
+        return jax.lax.fori_loop(0, N, it, jnp.float32(0))
+    f = jax.jit(fn)
+    out = f(); jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = f(); jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / N
+    print(f"R={R}: {dt*1e3:.4f} ms/iter  ({R/dt/1e9:.2f} Gelem/s)")
+# and a reduction-free variant to isolate the .sum()
+R = 10240
+vals = jax.random.normal(key, (R,))
+def fn2():
+    def it(i, carry):
+        return jnp.sin(carry) * 1.0001
+    return jax.lax.fori_loop(0, N, it, vals)
+f = jax.jit(fn2)
+out = f(); jax.block_until_ready(out)
+t0 = time.perf_counter()
+out = f(); jax.block_until_ready(out)
+print(f"no-reduce R=10240: {(time.perf_counter()-t0)/N*1e3:.4f} ms/iter")
+def fn3():
+    def it(i, carry):
+        return jnp.sin(carry) * 1.0001
+    return jax.lax.fori_loop(0, N, it, jnp.float32(1.0))
+f = jax.jit(fn3)
+out = f(); jax.block_until_ready(out)
+t0 = time.perf_counter()
+out = f(); jax.block_until_ready(out)
+print(f"scalar-only: {(time.perf_counter()-t0)/N*1e3:.4f} ms/iter")

@@ -1,0 +1,69 @@
+"""Ad-hoc profiling of the per-step cost on TPU (not part of the repo API)."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer import candidates as cgen
+from cruise_control_tpu.analyzer import optimizer as opt
+from cruise_control_tpu.analyzer.balancing_constraint import BalancingConstraint
+from cruise_control_tpu.analyzer.goals.specs import GOAL_SPECS, goals_by_priority
+from cruise_control_tpu.analyzer.state import BrokerArrays, OptimizationOptions
+from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster
+
+spec = ClusterSpec(num_brokers=50, num_racks=10, num_topics=40,
+                   mean_partitions_per_topic=84.0, replication_factor=3,
+                   distribution="exponential", seed=2026)
+model = generate_cluster(spec)
+options = OptimizationOptions.none(model)
+con = BalancingConstraint.default()
+ns, nd = cgen.default_num_sources(model), cgen.default_num_dests(model)
+print("ns,nd:", ns, nd)
+
+def bench(name, fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    N = 20
+    for _ in range(N):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / N * 1000
+    print(f"{name}: {dt:.2f} ms")
+    return out
+
+arr_fn = jax.jit(BrokerArrays.from_model)
+bench("BrokerArrays.from_model", arr_fn, model)
+
+stack = goals_by_priority([
+    "RackAwareGoal", "ReplicaCapacityGoal", "DiskCapacityGoal",
+    "NetworkInboundCapacityGoal", "NetworkOutboundCapacityGoal", "CpuCapacityGoal",
+    "ReplicaDistributionGoal", "PotentialNwOutGoal", "DiskUsageDistributionGoal",
+    "NetworkInboundUsageDistributionGoal", "NetworkOutboundUsageDistributionGoal",
+    "CpuUsageDistributionGoal", "TopicReplicaDistributionGoal",
+    "LeaderReplicaDistributionGoal", "LeaderBytesInDistributionGoal"])
+
+# single step, no prevs
+g = GOAL_SPECS["DiskUsageDistributionGoal"]
+step0 = opt._get_step_fn(g, (), con, ns, nd)
+bench("step disk_dist prevs=0", step0, model, options)
+# single step, full prevs
+step14 = opt._get_step_fn(stack[-1], tuple(stack[:-1]), con, ns, nd)
+bench("step lbi prevs=14", step14, model, options)
+step8 = opt._get_step_fn(stack[8], tuple(stack[:8]), con, ns, nd)
+bench("step disk_dist prevs=8", step8, model, options)
+# rack step
+steprack = opt._get_step_fn(stack[0], (), con, ns, nd)
+bench("step rack prevs=0", steprack, model, options)
+
+# fixpoint per goal timing
+for i, s in enumerate(stack):
+    fp = opt._get_fixpoint_fn(s, tuple(stack[:i]), con, ns, nd, 256)
+    m2, steps, total, b, a, c = fp(model, options)
+    jax.block_until_ready(m2)
+    t0 = time.perf_counter()
+    m2, steps, total, b, a, c = fp(model, options)
+    jax.block_until_ready(m2)
+    dt = (time.perf_counter() - t0) * 1000
+    print(f"fixpoint {s.name}: {dt:.1f} ms steps={int(steps)} actions={int(total)}")
+    model = m2

@@ -1,0 +1,52 @@
+"""Measure the real _goal_step body cost on device via fori_loop chaining."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer import candidates as cgen
+from cruise_control_tpu.analyzer import optimizer as opt
+from cruise_control_tpu.analyzer.balancing_constraint import BalancingConstraint
+from cruise_control_tpu.analyzer.goals.specs import goals_by_priority
+from cruise_control_tpu.analyzer.state import OptimizationOptions
+from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster
+
+spec = ClusterSpec(num_brokers=50, num_racks=10, num_topics=40,
+                   mean_partitions_per_topic=84.0, replication_factor=3,
+                   distribution="exponential", seed=2026)
+model = generate_cluster(spec)
+options = OptimizationOptions.none(model)
+con = BalancingConstraint.default()
+ns, nd = cgen.default_num_sources(model), cgen.default_num_dests(model)
+stack = goals_by_priority([
+    "RackAwareGoal", "ReplicaCapacityGoal", "DiskCapacityGoal",
+    "NetworkInboundCapacityGoal", "NetworkOutboundCapacityGoal", "CpuCapacityGoal",
+    "ReplicaDistributionGoal", "PotentialNwOutGoal", "DiskUsageDistributionGoal",
+    "NetworkInboundUsageDistributionGoal", "NetworkOutboundUsageDistributionGoal",
+    "CpuUsageDistributionGoal", "TopicReplicaDistributionGoal",
+    "LeaderReplicaDistributionGoal", "LeaderBytesInDistributionGoal"])
+
+N = 50
+
+def run_steps(m, o, g, prevs):
+    def body(i, carry):
+        mm, total = carry
+        mm2, n = opt._goal_step(mm, o, g, prevs, con, ns, nd, None)
+        return (mm2, total + n)
+    return jax.lax.fori_loop(0, N, body, (m, jnp.int32(0)))
+
+for name, g, prevs in [("disk_dist/0", stack[8], ()),
+                       ("disk_dist/8", stack[8], tuple(stack[:8])),
+                       ("lbi/14", stack[14], tuple(stack[:14])),
+                       ("rack/0", stack[0], ())]:
+    f = jax.jit(lambda m, o, g=g, p=prevs: run_steps(m, o, g, p))
+    t0 = time.perf_counter()
+    out = f(model, options)
+    jax.block_until_ready(out)
+    compile_and_run = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = f(model, options)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    print(f"{name}: {dt / N * 1000:.2f} ms/step (first call incl compile: "
+          f"{compile_and_run:.1f}s) actions={int(out[1])}")

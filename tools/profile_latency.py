@@ -1,0 +1,47 @@
+"""Profile the per-goal step counts and wall time of the fused stack.
+
+Usage: BENCH_SCALE=small python tools/profile_latency.py
+Runs the UNFUSED path so per-goal durations are real, and prints
+steps/actions/duration per goal to find where the serial-iteration floor is.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import SCALES, STACK  # noqa: E402
+
+
+def main():
+    scale = os.environ.get("BENCH_SCALE", "small")
+    brokers, racks, topics, ppt, rf = SCALES[scale]
+    from cruise_control_tpu.analyzer import optimizer as opt
+    from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster
+
+    spec = ClusterSpec(num_brokers=brokers, num_racks=racks, num_topics=topics,
+                       mean_partitions_per_topic=ppt, replication_factor=rf,
+                       distribution="exponential", seed=2026)
+    model = generate_cluster(spec)
+    print(f"model: B={model.num_brokers} R={model.num_replicas_padded} "
+          f"P={model.num_partitions} T={model.num_topics}", flush=True)
+
+    # warm-up (compile)
+    t0 = time.monotonic()
+    opt.optimize(model, STACK, raise_on_hard_failure=False, fused=False)
+    print(f"compile+run: {time.monotonic()-t0:.2f}s", flush=True)
+
+    t0 = time.monotonic()
+    run = opt.optimize(model, STACK, raise_on_hard_failure=False, fused=False)
+    wall = time.monotonic() - t0
+    tot_steps = 0
+    for g in run.goal_results:
+        tot_steps += g.steps
+        print(f"{g.name:44s} steps={g.steps:4d} actions={g.actions_applied:5d} "
+              f"dur={g.duration_s*1000:8.1f}ms sat={g.satisfied_after} capped={g.capped}")
+    print(f"TOTAL wall={wall:.3f}s steps={tot_steps} "
+          f"per-step={wall/max(tot_steps,1)*1000:.1f}ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+"""Reproduce the large-rung TPU kernel fault goal by goal.
+
+Runs the large model (200 brokers / 100k replicas) through the UNFUSED
+optimizer one goal at a time with progress prints, so the crashing goal is
+identifiable from the last line printed before the worker dies.
+
+Usage: python tools/repro_large.py [start_goal_index]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import SCALES, STACK  # noqa: E402
+
+
+def main():
+    brokers, racks, topics, ppt, rf = SCALES[os.environ.get("BENCH_SCALE", "large")]
+    start = int(sys.argv[1]) if len(sys.argv) > 1 else 0
+    from cruise_control_tpu.analyzer import optimizer as opt
+    from cruise_control_tpu.analyzer.balancing_constraint import BalancingConstraint
+    from cruise_control_tpu.analyzer.state import OptimizationOptions
+    from cruise_control_tpu.analyzer.goals.specs import goals_by_priority
+    from cruise_control_tpu.analyzer import candidates as cgen
+    from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster
+    import jax
+
+    spec = ClusterSpec(num_brokers=brokers, num_racks=racks, num_topics=topics,
+                       mean_partitions_per_topic=ppt, replication_factor=rf,
+                       distribution="exponential", seed=2026)
+    model = generate_cluster(spec)
+    print(f"model: B={model.num_brokers} Rpad={model.num_replicas_padded} "
+          f"P={model.num_partitions} T={model.num_topics} "
+          f"max_rf={model.max_rf}", flush=True)
+    model = jax.device_put(model)
+    jax.block_until_ready(model)
+    print("model on device", flush=True)
+
+    constraint = BalancingConstraint.default()
+    options = OptimizationOptions.none(model)
+    specs = goals_by_priority(STACK)
+    ns = cgen.default_num_sources(model)
+    nd = cgen.default_num_dests(model)
+    print(f"S={ns} D={nd} K={ns*nd}", flush=True)
+
+    prev = ()
+    for i, gspec in enumerate(specs):
+        if i < start:
+            prev = prev + (gspec,)
+            continue
+        t0 = time.monotonic()
+        print(f"[{i}] {gspec.name} compiling+running...", flush=True)
+        fixpoint = opt._get_fixpoint_fn(gspec, prev, constraint, ns, nd, 256)
+        out = fixpoint(model, options)
+        jax.block_until_ready(out)
+        model, steps, total, before, after, capped = out
+        print(f"[{i}] {gspec.name} done steps={int(steps)} actions={int(total)} "
+              f"sat={bool(after)} capped={bool(capped)} "
+              f"dur={time.monotonic()-t0:.1f}s", flush=True)
+        prev = prev + (gspec,)
+    print("ALL GOALS COMPLETE", flush=True)
+
+
+if __name__ == "__main__":
+    main()
