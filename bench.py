@@ -54,7 +54,16 @@ def main() -> None:
     model = generate_cluster(spec)
     num_replicas = int(model.replica_valid.sum())
 
+    # Ship the model to the device once — re-transferring the ~20 host
+    # arrays on every jit call costs several tunnel round trips.
+    import jax
+    model = jax.device_put(model)
+    jax.block_until_ready(model)
+
     # Warm-up: compile the fused stack program (cached for the timed run).
+    # optimize() chunks the fusion automatically at ≥100 brokers (the
+    # one-program 15-goal compile kernel-faults the TPU worker at 200-broker
+    # shapes — chunks of 5 compile and run fine).
     opt.optimize(model, STACK, raise_on_hard_failure=False, fused=True)
 
     t0 = time.monotonic()

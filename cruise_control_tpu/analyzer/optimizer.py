@@ -49,7 +49,7 @@ from cruise_control_tpu.analyzer.balancing_constraint import BalancingConstraint
 from cruise_control_tpu.analyzer.goals import kernels
 from cruise_control_tpu.analyzer.goals.specs import GoalSpec, goals_by_priority
 from cruise_control_tpu.analyzer.state import BrokerArrays, OptimizationOptions
-from cruise_control_tpu.model.stats import ClusterModelStats, compute_stats
+from cruise_control_tpu.model.stats import ClusterModelStats, compute_stats_jit
 from cruise_control_tpu.model.tensor_model import TensorClusterModel
 
 _MIN_SCORE = 1e-9  # strictly-positive improvement required (greedy accept)
@@ -179,6 +179,19 @@ def select_batched(score: Array, cand: Candidates, eligible: Array,
     """
     num_brokers, num_partitions = model.num_brokers, model.num_partitions
     eps = 1e-6
+    # Decorrelating tie-break: _best_per_segment resolves equal scores by
+    # lowest candidate index, and the K batch is replica-major / dest-minor
+    # with destinations in one global top-D order — so for tie-heavy goals
+    # (rack conflicts, count distributions: scores are small integers) every
+    # source broker's winner picked the SAME destination, the per-dest pass
+    # then kept ONE action, and steps landed ~1 action per round regardless
+    # of batch width.  A tiny multiplicative hash-jitter (≤1e-4 relative)
+    # spreads near-tied winners across destinations without reordering
+    # meaningfully different scores.
+    idx_k = jnp.arange(score.shape[0], dtype=jnp.uint32)
+    jitter = ((idx_k * jnp.uint32(2654435761)) >> 12).astype(jnp.float32) / \
+        jnp.float32(1 << 20)
+    score = score * (1.0 + 1e-4 * jitter)
     keep_total = jnp.zeros_like(eligible)
     used_part = jnp.zeros((num_partitions,), bool)
     cum_src = jnp.zeros((num_brokers, NUM_CHANNELS), jnp.float32)
@@ -361,14 +374,18 @@ def _get_fixpoint_fn(spec: GoalSpec, prev_specs: Tuple[GoalSpec, ...],
 
 def _stack_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
                     specs: Tuple[GoalSpec, ...], constraint: BalancingConstraint,
-                    num_sources: int, num_dests: int, max_steps: int, mesh=None):
-    """The ENTIRE goal stack in one XLA program: each goal's while_loop runs
+                    num_sources: int, num_dests: int, max_steps: int, mesh=None,
+                    prev_specs: Tuple[GoalSpec, ...] = ()):
+    """A run of goals in one XLA program: each goal's while_loop runs
     in priority order, prev-goal acceptance masks accumulating exactly as in
-    the unfused path.  One dispatch + one host transfer for a full
-    optimization — the per-goal dispatch/sync overhead matters on a
-    tunneled TPU (15 goals × dispatch + 6 scalar fetches each)."""
+    the unfused path.  One dispatch + one host transfer for the whole run —
+    the per-goal dispatch/sync overhead matters on a tunneled TPU (15 goals
+    × dispatch + 6 scalar fetches each).  ``prev_specs`` seeds the
+    already-optimized set, so a long stack can be split into a few chunked
+    programs (the 200-broker single-program compile kernel-faults the TPU
+    worker; see optimize(fuse_group_size=...))."""
     steps_l, actions_l, before_l, after_l, capped_l = [], [], [], [], []
-    prev: Tuple[GoalSpec, ...] = ()
+    prev: Tuple[GoalSpec, ...] = tuple(prev_specs)
     for spec in specs:
         model, steps, total, before, after, capped = _goal_fixpoint(
             model, options, spec, prev, constraint, num_sources, num_dests,
@@ -379,21 +396,30 @@ def _stack_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
         after_l.append(after)
         capped_l.append(capped)
         prev = prev + (spec,)
-    return (model, jnp.stack(steps_l), jnp.stack(actions_l),
-            jnp.stack(before_l), jnp.stack(after_l), jnp.stack(capped_l))
+    # One i32[5, G] result matrix: a single host fetch covers the whole run
+    # (each device_get round trip costs ~0.5-1 s over a tunneled TPU; five
+    # separate vectors were five round trips).
+    packed = jnp.stack([
+        jnp.stack(steps_l), jnp.stack(actions_l),
+        jnp.stack(before_l).astype(jnp.int32),
+        jnp.stack(after_l).astype(jnp.int32),
+        jnp.stack(capped_l).astype(jnp.int32)])
+    return model, packed
 
 
 _stack_cache: Dict[tuple, object] = {}
 
 
 def _get_stack_fn(specs: Tuple[GoalSpec, ...], constraint: BalancingConstraint,
-                  num_sources: int, num_dests: int, max_steps: int, mesh=None):
-    key = (specs, constraint, num_sources, num_dests, max_steps, mesh)
+                  num_sources: int, num_dests: int, max_steps: int, mesh=None,
+                  prev_specs: Tuple[GoalSpec, ...] = ()):
+    key = (specs, constraint, num_sources, num_dests, max_steps, mesh, prev_specs)
     fn = _stack_cache.get(key)
     if fn is None:
         fn = jax.jit(partial(_stack_fixpoint, specs=specs, constraint=constraint,
                              num_sources=num_sources, num_dests=num_dests,
-                             max_steps=max_steps, mesh=mesh))
+                             max_steps=max_steps, mesh=mesh,
+                             prev_specs=prev_specs))
         _stack_cache[key] = fn
     return fn
 
@@ -457,7 +483,8 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
              max_steps_per_goal: int = 256,
              num_sources: Optional[int] = None, num_dests: Optional[int] = None,
              raise_on_hard_failure: bool = True,
-             fused: bool = False) -> OptimizerRun:
+             fused: bool = False,
+             fuse_group_size: Optional[int] = None) -> OptimizerRun:
     """Run the goal stack in priority order (GoalOptimizer.optimizations).
 
     Each goal optimizes the model to its fixpoint, constrained by the
@@ -469,12 +496,19 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
     dispatch + one transfer per optimization, per-goal wall times folded
     into the total) — what the service and bench use; the unfused path
     keeps per-goal compile caching, better for many distinct small stacks.
+    ``fuse_group_size`` splits the fused stack into chunks of that many
+    goals (each its own program, acceptance context carried across): the
+    single 15-goal program at 200-broker shapes kernel-faults the TPU
+    worker, while the same goals compile and run fine as smaller programs.
     """
     constraint = constraint or BalancingConstraint.default()
     options = options if options is not None else OptimizationOptions.none(model)
     specs = goals_by_priority(goal_names)
 
-    stats_before = compute_stats(model)
+    # Jitted: ONE runtime dispatch instead of ~30 eager ops (each eager op
+    # is an RPC to a tunneled TPU runtime; results stay on device, lazily
+    # fetched by to_dict()).
+    stats_before = compute_stats_jit(model)
     results: List[GoalResult] = []
     ns = num_sources or cgen.default_num_sources(model)
     nd = num_dests or cgen.default_num_dests(model)
@@ -490,12 +524,26 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
 
     if fused:
         t0 = time.monotonic()
-        stack_fn = _get_stack_fn(tuple(specs), constraint, ns, nd,
-                                 max_steps_per_goal)
-        model, steps_v, actions_v, before_v, after_v, capped_v = \
-            stack_fn(model, options)
-        steps_v, actions_v, before_v, after_v, capped_v = jax.device_get(
-            (steps_v, actions_v, before_v, after_v, capped_v))
+        # Default chunking is adaptive: one program for small models, chunks
+        # of 5 goals at ≥100 brokers — the single 15-goal program at
+        # 200-broker shapes kernel-faults the TPU worker, and EVERY fused
+        # caller (service facade included) must get the safe default, not
+        # just the bench.
+        if fuse_group_size is None and model.num_brokers >= 100:
+            fuse_group_size = 5
+        group = fuse_group_size or len(specs) or 1
+        packed_rows = []
+        prev: Tuple[GoalSpec, ...] = ()
+        for start in range(0, len(specs), group):
+            chunk = tuple(specs[start:start + group])
+            stack_fn = _get_stack_fn(chunk, constraint, ns, nd,
+                                     max_steps_per_goal, prev_specs=prev)
+            model, packed = stack_fn(model, options)
+            packed_rows.append(packed)
+            prev = prev + chunk
+        fetched = jax.device_get(tuple(packed_rows))
+        steps_v, actions_v, before_v, after_v, capped_v = (
+            np.concatenate([row[i] for row in fetched]) for i in range(5))
         per_goal_s = (time.monotonic() - t0) / max(len(specs), 1)
         for i, spec in enumerate(specs):
             scored += int(steps_v[i]) * k_of(spec)
@@ -528,12 +576,14 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
             prev = prev + (spec,)
 
     from cruise_control_tpu.analyzer.provisioning import (ProvisionResponse,
+                                                          host_view,
                                                           provision_verdict_for_goal)
     provision = ProvisionResponse()
+    view = host_view(model)
     for spec, res in zip(specs, results):
         provision.aggregate(provision_verdict_for_goal(spec, model, constraint,
-                                                       res.satisfied_after))
+                                                       res.satisfied_after, view))
 
     return OptimizerRun(model=model, goal_results=results, stats_before=stats_before,
-                        stats_after=compute_stats(model), num_candidates_scored=scored,
+                        stats_after=compute_stats_jit(model), num_candidates_scored=scored,
                         provision_response=provision)

@@ -74,14 +74,40 @@ class ProvisionResponse:
                 "recommendations": [r.to_dict() for r in self.recommendations]}
 
 
+@dataclasses.dataclass
+class _HostView:
+    """Host copies of the model arrays every per-goal verdict reads —
+    fetched ONCE per optimization/detection pass (each eager np.asarray is
+    a device round trip on a tunneled TPU; 15 goals × 4 arrays was ~60)."""
+
+    alive: np.ndarray
+    load: np.ndarray
+    cap: np.ndarray
+    replica_counts: np.ndarray
+    rf_max: int
+
+
+def host_view(model: TensorClusterModel) -> _HostView:
+    import jax
+    alive, load, cap, counts, rf = jax.device_get((
+        model.alive_broker_mask(), model.broker_load(), model.broker_capacity,
+        model.broker_replica_counts(), model.partition_replication_factor()))
+    return _HostView(alive=alive, load=load, cap=cap, replica_counts=counts,
+                     rf_max=int(rf.max(initial=0)))
+
+
 def provision_verdict_for_goal(spec: GoalSpec, model: TensorClusterModel,
                                constraint: BalancingConstraint,
-                               satisfied_after: bool) -> ProvisionRecommendation:
+                               satisfied_after: bool,
+                               view: Optional[_HostView] = None
+                               ) -> ProvisionRecommendation:
     """Per-goal verdict after optimization."""
-    alive = np.asarray(model.alive_broker_mask())
+    if view is None:
+        view = host_view(model)
+    alive = view.alive
     num_alive = max(int(alive.sum()), 1)
-    load = np.asarray(model.broker_load())[alive]
-    cap = np.asarray(model.broker_capacity)[alive]
+    load = view.load[alive]
+    cap = view.cap[alive]
 
     if spec.kind in ("capacity", "potential_nw_out"):
         res = spec.resource if spec.resource >= 0 else int(Resource.NW_OUT)
@@ -119,7 +145,7 @@ def provision_verdict_for_goal(spec: GoalSpec, model: TensorClusterModel,
         return ProvisionRecommendation(ProvisionStatus.RIGHT_SIZED, resource=res)
 
     if spec.kind == "replica_capacity":
-        counts = np.asarray(model.broker_replica_counts())[alive]
+        counts = view.replica_counts[alive]
         if not satisfied_after:
             total = int(counts.sum())
             needed = math.ceil(total / constraint.max_replicas_per_broker) - num_alive
@@ -130,7 +156,7 @@ def provision_verdict_for_goal(spec: GoalSpec, model: TensorClusterModel,
         return ProvisionRecommendation(ProvisionStatus.RIGHT_SIZED)
 
     if spec.kind in ("rack", "rack_distribution") and not satisfied_after:
-        rf = int(np.asarray(model.partition_replication_factor()).max(initial=0))
+        rf = view.rf_max
         if rf > model.num_racks:
             return ProvisionRecommendation(
                 ProvisionStatus.UNDER_PROVISIONED, num_brokers=-1,

@@ -60,11 +60,15 @@ class OptimizationOptions:
 
     @classmethod
     def none(cls, model: TensorClusterModel) -> "OptimizationOptions":
+        # Host (numpy) leaves: on a tunneled TPU each eager jnp.zeros is one
+        # runtime RPC; jit arguments are shipped in a single batched
+        # transfer instead.
+        import numpy as np
         B = model.num_brokers
         return cls(
-            topic_excluded=jnp.zeros((model.num_topics,), bool),
-            broker_excluded_replica_move=jnp.zeros((B,), bool),
-            broker_excluded_leadership=jnp.zeros((B,), bool),
-            requested_dest_only=jnp.zeros((B,), bool),
-            only_move_immigrants=jnp.zeros((), bool),
+            topic_excluded=np.zeros((model.num_topics,), bool),
+            broker_excluded_replica_move=np.zeros((B,), bool),
+            broker_excluded_leadership=np.zeros((B,), bool),
+            requested_dest_only=np.zeros((B,), bool),
+            only_move_immigrants=np.zeros((), bool),
         )

@@ -55,6 +55,9 @@ class OperationResult:
     stats_after: Dict[str, object]
     execution: Optional[object] = None  # ExecutionResult when not dryrun
     reason: str = ""
+    # Goals whose step loop hit max_steps while still applying actions: the
+    # run may not be a true fixpoint for them (GoalResult.capped).
+    capped_goals: List[str] = dataclasses.field(default_factory=list)
 
     def to_dict(self) -> Dict[str, object]:
         out = {
@@ -68,6 +71,7 @@ class OperationResult:
             "statsBefore": self.stats_before,
             "statsAfter": self.stats_after,
             "reason": self.reason,
+            "cappedGoals": self.capped_goals,
         }
         if self.execution is not None:
             out["execution"] = dataclasses.asdict(self.execution)
@@ -119,12 +123,14 @@ class CruiseControl:
     def _optimize(self, model: TensorClusterModel, goals: Optional[Sequence[str]],
                   options: Optional[OptimizationOptions] = None) -> opt.OptimizerRun:
         goal_list = list(goals) if goals else self.goals
+        from cruise_control_tpu.common.sensors import SENSORS
         # Requested non-hard-only goal subsets still honor hard goals first
         # (GoalBasedOperationRunnable skip-hard-goal-check semantics are an
         # explicit flag in the reference; default keeps them).
-        return opt.optimize(model, goal_list, constraint=self.constraint,
-                            options=options, raise_on_hard_failure=False,
-                            fused=True)
+        with SENSORS.timer("GoalOptimizer.proposal-computation-timer").time():
+            return opt.optimize(model, goal_list, constraint=self.constraint,
+                                options=options, raise_on_hard_failure=False,
+                                fused=True)
 
     def _finish(self, model: TensorClusterModel, run: opt.OptimizerRun,
                 dryrun: bool, reason: str, naming: Dict[str, object],
@@ -134,6 +140,7 @@ class CruiseControl:
         # ReassignmentRequests / throttle entries — carries cluster ids from
         # the SAME snapshot the model was built from.
         dense_proposals = props.diff(model, run.model)
+        capped = [g.name for g in run.goal_results if g.capped]
         if verify:
             try:
                 verify_run(model, run, [g.name for g in run.goal_results],
@@ -148,7 +155,8 @@ class CruiseControl:
                     provision_status=run.provision_response.status.value,
                     stats_before=run.stats_before.to_dict(),
                     stats_after=run.stats_after.to_dict(),
-                    reason=f"{reason} [verification failed: {e}]")
+                    reason=f"{reason} [verification failed: {e}]",
+                    capped_goals=capped)
         proposals = props.renumber_brokers(dense_proposals, naming["brokers"])
         execution = None
         ok = True
@@ -163,7 +171,7 @@ class CruiseControl:
             provision_status=run.provision_response.status.value,
             stats_before=run.stats_before.to_dict(),
             stats_after=run.stats_after.to_dict(),
-            execution=execution, reason=reason)
+            execution=execution, reason=reason, capped_goals=capped)
 
     # ------------------------------------------------------------------
     # Proposals (cached)
@@ -187,7 +195,9 @@ class CruiseControl:
                             provision_status=crun.provision_response.status.value,
                             stats_before=crun.stats_before.to_dict(),
                             stats_after=crun.stats_after.to_dict(),
-                            reason="cached")
+                            reason="cached",
+                            capped_goals=[g.name for g in crun.goal_results
+                                          if g.capped])
         model, naming = self._model_naming()
         run = self._optimize(model, goals)
         result = self._finish(model, run, dryrun=True, reason="proposals",
@@ -376,6 +386,8 @@ class CruiseControl:
         if detector_manager is not None:
             out["AnomalyDetectorState"] = detector_manager.state.to_dict(
                 detector_manager.notifier)
+        from cruise_control_tpu.common.sensors import SENSORS
+        out["Sensors"] = SENSORS.snapshot()
         return out
 
     def kafka_cluster_state(self) -> Dict[str, object]:

@@ -41,7 +41,8 @@ from cruise_control_tpu.detector.anomalies import AnomalyType
 PREFIX = "/kafkacruisecontrol"
 
 GET_ENDPOINTS = {"bootstrap", "train", "load", "partition_load", "proposals",
-                 "state", "kafka_cluster_state", "user_tasks", "review_board"}
+                 "state", "kafka_cluster_state", "user_tasks", "review_board",
+                 "metrics"}
 POST_ENDPOINTS = {"add_broker", "remove_broker", "fix_offline_replicas",
                   "rebalance", "stop_proposal_execution", "pause_sampling",
                   "resume_sampling", "demote_broker", "admin", "review",
@@ -233,6 +234,15 @@ class CruiseControlApi:
     def _ep_kafka_cluster_state(self, q):
         return 200, self.cc.kafka_cluster_state(), {}
 
+    def _ep_metrics(self, q):
+        """Sensor registry (Sensors.md): JSON by default; Prometheus
+        exposition text with ?format=prometheus (the /metrics surface the
+        reference exports via JMX)."""
+        from cruise_control_tpu.common.sensors import SENSORS
+        if q.get("format") == "prometheus":
+            return 200, PlainText(SENSORS.prometheus_text()), {}
+        return 200, SENSORS.snapshot(), {}
+
     def _ep_load(self, q):
         def fn(progress):
             progress.add_step("WaitingForClusterModel")
@@ -423,6 +433,10 @@ class CruiseControlApi:
             approve, discard, q.get("reason", ""))}, {}
 
 
+class PlainText(str):
+    """Marker: endpoint result is preformatted text, not JSON."""
+
+
 class _Handler(BaseHTTPRequestHandler):
     api: CruiseControlApi = None  # injected by serve()
 
@@ -439,9 +453,14 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(status, body, headers)
 
     def _reply(self, status: int, body: Dict, headers: Dict[str, str]) -> None:
-        payload = json.dumps(body, default=str).encode()
+        if isinstance(body, PlainText):
+            payload = str(body).encode()
+            ctype = "text/plain; version=0.0.4"
+        else:
+            payload = json.dumps(body, default=str).encode()
+            ctype = "application/json"
         self.send_response(status)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(payload)))
         for k, v in headers.items():
             self.send_header(k, v)

@@ -1,0 +1,167 @@
+"""Observability sensors: counters, gauges, timers.
+
+Parity with the reference's Dropwizard MetricRegistry → JMX domain
+``kafka.cruisecontrol`` (KafkaCruiseControlApp.java:39-41; sensor list in
+docs/wiki/User Guide/Sensors.md; registrations at LoadMonitor.java:180-195,
+Executor.registerGaugeSensors Executor.java:271, AnomalyDetectorState.java).
+A JVM-free build has no JMX; sensors surface through ``/state`` JSON and a
+``/metrics`` Prometheus text endpoint instead.
+
+Sensor kinds:
+- Counter: monotonically increasing count (anomaly counts, completed tasks).
+- Gauge: instantaneous value, either set explicitly or computed by a
+  callback at read time (valid-windows, in-progress movements).
+- Timer: event durations — count, mean, max, and a decaying last-N
+  percentile window (proposal-computation-timer).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Deque, Dict, Optional
+
+
+class Counter:
+    def __init__(self):
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._v += n
+
+    @property
+    def count(self) -> int:
+        return self._v
+
+
+class Gauge:
+    def __init__(self, fn: Optional[Callable[[], float]] = None):
+        self._fn = fn
+        self._v: float = 0.0
+
+    def set(self, v: float) -> None:
+        self._v = float(v)
+
+    @property
+    def value(self) -> float:
+        if self._fn is not None:
+            try:
+                return float(self._fn())
+            except Exception:
+                return float("nan")
+        return self._v
+
+
+class Timer:
+    """Duration sensor with a bounded sample window for percentiles."""
+
+    def __init__(self, window: int = 128):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._total = 0.0
+        self._max = 0.0
+        self._samples: Deque[float] = deque(maxlen=window)
+
+    def update(self, seconds: float) -> None:
+        with self._lock:
+            self._count += 1
+            self._total += seconds
+            self._max = max(self._max, seconds)
+            self._samples.append(seconds)
+
+    def time(self):
+        timer = self
+
+        class _Ctx:
+            def __enter__(self):
+                self._t0 = time.monotonic()
+                return self
+
+            def __exit__(self, *exc):
+                timer.update(time.monotonic() - self._t0)
+                return False
+
+        return _Ctx()
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            n = self._count
+            mean = self._total / n if n else 0.0
+            samples = sorted(self._samples)
+            p99 = samples[int(0.99 * (len(samples) - 1))] if samples else 0.0
+            return {"count": n, "mean_s": mean, "max_s": self._max, "p99_s": p99}
+
+
+class MetricRegistry:
+    """Name → sensor registry; one per process (``SENSORS``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._timers: Dict[str, Timer] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            return self._counters.setdefault(name, Counter())
+
+    def gauge(self, name: str, fn: Optional[Callable[[], float]] = None) -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None or fn is not None:
+                g = Gauge(fn) if fn is not None else (g or Gauge())
+                self._gauges[name] = g
+            return g
+
+    def timer(self, name: str) -> Timer:
+        with self._lock:
+            return self._timers.setdefault(name, Timer())
+
+    def snapshot(self) -> Dict[str, object]:
+        """All sensors as one JSON-able dict (the /state surface).  A gauge
+        whose callback failed reports None — ``json.dumps`` would otherwise
+        emit a bare ``NaN`` literal that strict parsers reject, letting one
+        broken sensor break the whole /state payload."""
+        import math
+        out: Dict[str, object] = {}
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            timers = dict(self._timers)
+        for name, c in sorted(counters.items()):
+            out[name] = c.count
+        for name, g in sorted(gauges.items()):
+            v = g.value
+            out[name] = v if math.isfinite(v) else None
+        for name, t in sorted(timers.items()):
+            out[name] = t.snapshot()
+        return out
+
+    def prometheus_text(self, prefix: str = "kafka_cruisecontrol") -> str:
+        """Prometheus exposition text (the /metrics surface)."""
+        def clean(name: str) -> str:
+            return name.replace(".", "_").replace("-", "_")
+
+        lines = []
+        snap = self.snapshot()
+        for name, value in snap.items():
+            metric = f"{prefix}_{clean(name)}"
+            if isinstance(value, dict):  # timer
+                for k, v in value.items():
+                    lines.append(f"{metric}_{clean(k)} {v}")
+            elif value is not None:  # failed gauge callbacks are omitted
+                lines.append(f"{metric} {value}")
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._timers.clear()
+
+
+#: Process-wide registry (the reference's shared Dropwizard registry).
+SENSORS = MetricRegistry()

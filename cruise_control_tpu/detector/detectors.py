@@ -51,11 +51,18 @@ from cruise_control_tpu.monitor.metricdef import KAFKA_METRIC_DEF
 
 class GoalViolationDetector:
     def __init__(self, load_monitor: LoadMonitor, detection_goals: Sequence[str],
-                 constraint: Optional[BalancingConstraint] = None):
+                 constraint: Optional[BalancingConstraint] = None,
+                 provisioner=None):
         self._lm = load_monitor
         self._goals = list(detection_goals)
         self._constraint = constraint or BalancingConstraint.default()
+        # Provisioner SPI (detector/Provisioner.java): receives UNDER/OVER
+        # recommendations aggregated over the detection pass
+        # (GoalViolationDetector.java:160-237 optionally right-sizes).
+        self._provisioner = provisioner
         self.last_checked_generation: Optional[Tuple[int, int]] = None
+        self.last_provision_response = None
+        self.last_rightsize_result = None
 
     def detect(self, now_ms: int) -> Optional[GoalViolations]:
         try:
@@ -72,13 +79,28 @@ class GoalViolationDetector:
         fixable: List[str] = []
         unfixable: List[str] = []
         rf_max = int(np.asarray(model.partition_replication_factor()).max(initial=0))
+        from cruise_control_tpu.analyzer.provisioning import (
+            ProvisionResponse, ProvisionStatus, host_view,
+            provision_verdict_for_goal)
+        provision = ProvisionResponse()
+        view = host_view(model)
         for spec in goals_by_priority(self._goals):
-            if bool(kernels.goal_satisfied(spec, model, arrays, self._constraint)):
+            satisfied = bool(kernels.goal_satisfied(spec, model, arrays,
+                                                    self._constraint))
+            provision.aggregate(provision_verdict_for_goal(
+                spec, model, self._constraint, satisfied, view))
+            if satisfied:
                 continue
             if spec.kind in ("rack", "rack_distribution") and rf_max > model.num_racks:
                 unfixable.append(spec.name)
             else:
                 fixable.append(spec.name)
+        self.last_provision_response = provision
+        if self._provisioner is not None and provision.status in (
+                ProvisionStatus.UNDER_PROVISIONED,
+                ProvisionStatus.OVER_PROVISIONED):
+            self.last_rightsize_result = self._provisioner.rightsize(
+                provision.recommendations)
         if not fixable and not unfixable:
             return None
         return GoalViolations(detection_time_ms=now_ms, fixable_goals=fixable,

@@ -144,6 +144,9 @@ class AnomalyDetectorManager:
         return handled
 
     def _handle(self, anomaly: Anomaly, now_ms: int) -> int:
+        from cruise_control_tpu.common.sensors import SENSORS
+        SENSORS.counter(
+            f"AnomalyDetector.{type(anomaly).__name__}-rate").inc()
         result = self._notifier.on_anomaly(anomaly, now_ms)
         if result.action == AnomalyNotificationAction.IGNORE:
             self.state.update_status(anomaly, "IGNORED", now_ms)

@@ -124,6 +124,33 @@ class Executor:
         self._min_isr_pressure_fn = min_isr_pressure_fn or (lambda: False)
         self._task_manager: Optional[ExecutionTaskManager] = None
         self._adjuster = ConcurrencyAdjuster(self._limits)
+        # Sensor registrations (Executor.registerGaugeSensors,
+        # Executor.java:271; Sensors.md execution gauges).
+        from cruise_control_tpu.common.sensors import SENSORS
+        from cruise_control_tpu.executor.task import TaskType as _TT
+
+        def _in_progress(task_type):
+            def read() -> int:
+                with self._lock:
+                    tm = self._task_manager
+                if tm is None:
+                    return 0
+                return sum(1 for t in tm.tasks_by_state()[TaskState.IN_PROGRESS]
+                           if t.task_type == task_type)
+            return read
+
+        SENSORS.gauge("Executor.inter-broker-partition-movements-in-progress",
+                      _in_progress(_TT.INTER_BROKER_REPLICA_ACTION))
+        SENSORS.gauge("Executor.intra-broker-partition-movements-in-progress",
+                      _in_progress(_TT.INTRA_BROKER_REPLICA_ACTION))
+        SENSORS.gauge("Executor.leadership-movements-in-progress",
+                      _in_progress(_TT.LEADER_ACTION))
+        SENSORS.gauge("Executor.execution-in-progress",
+                      lambda: float(self.has_ongoing_execution))
+        self._sensor_started = SENSORS.counter("Executor.executions-started")
+        self._sensor_stopped = SENSORS.counter("Executor.executions-stopped")
+        self._sensor_completed = SENSORS.counter("Executor.tasks-completed")
+        self._sensor_dead = SENSORS.counter("Executor.tasks-dead")
 
     # -- state -------------------------------------------------------------
     def state(self) -> ExecutorState:
@@ -290,6 +317,11 @@ class Executor:
 
             stopped = stopped or self._stop_requested
             buckets = tm.tasks_by_state()
+            self._sensor_started.inc()
+            if stopped:
+                self._sensor_stopped.inc()
+            self._sensor_completed.inc(len(buckets[TaskState.COMPLETED]))
+            self._sensor_dead.inc(len(buckets[TaskState.DEAD]))
             return ExecutionResult(
                 completed=len(buckets[TaskState.COMPLETED]),
                 dead=len(buckets[TaskState.DEAD]),
@@ -413,6 +445,12 @@ class Executor:
             if not timed_out:
                 self._admin.elect_leaders([partition_names[t.proposal.partition]
                                            for t in tasks])
+            else:
+                # Don't leave the preferred-order reassignments of killed
+                # tasks in flight (same cleanup as the inter-broker DEAD
+                # path; the reference deletes the reassignment znodes).
+                self._admin.cancel_reassignments(
+                    [partition_names[t.proposal.partition] for t in tasks])
             for t in tasks:
                 if timed_out:
                     t.kill()

@@ -23,6 +23,13 @@ cruise-control-metrics-reporter/src/test/.../utils/) for an image without
 a JVM.
 """
 
+from cruise_control_tpu.kafka.admin import KafkaClusterAdmin
 from cruise_control_tpu.kafka.client import KafkaClient, KafkaError
+from cruise_control_tpu.kafka.metadata import (KafkaMetadataRefresher,
+                                               cluster_metadata_from_kafka)
+from cruise_control_tpu.kafka.sample_store import KafkaSampleStore
+from cruise_control_tpu.kafka.sampler import KafkaMetricSampler
 
-__all__ = ["KafkaClient", "KafkaError"]
+__all__ = ["KafkaClient", "KafkaError", "KafkaClusterAdmin",
+           "KafkaMetadataRefresher", "cluster_metadata_from_kafka",
+           "KafkaSampleStore", "KafkaMetricSampler"]

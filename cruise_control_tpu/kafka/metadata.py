@@ -23,20 +23,30 @@ def cluster_metadata_from_kafka(client: KafkaClient,
                                 exclude_topics: Sequence[str] = ()) -> ClusterMetadata:
     md = client.metadata()
     alive_ids: Set[int] = {b.node_id for b in md.brokers}
-    brokers = tuple(BrokerInfo(
+    brokers = [BrokerInfo(
         broker_id=b.node_id, rack=b.rack or f"rack-{b.node_id}",
-        host=b.host, is_alive=True) for b in md.brokers)
+        host=b.host, is_alive=True) for b in md.brokers]
     skip = set(exclude_topics)
     partitions = []
+    dead_ids: Set[int] = set()
     for p in md.partitions:
         if p.topic in skip:
             continue
         offline = tuple(b for b in p.replicas
                         if b not in alive_ids or b not in p.isr and p.leader < 0)
+        dead_ids.update(b for b in p.replicas if b not in alive_ids)
         partitions.append(PartitionInfo(
             topic=p.topic, partition=p.partition, leader=p.leader,
             replicas=p.replicas, offline_replicas=offline))
-    return ClusterMetadata(brokers=brokers, partitions=tuple(partitions))
+    # Kafka drops dead brokers from Metadata while their ids linger in
+    # partition replica lists; the model needs a (dead) BrokerInfo row for
+    # each or model building KeyErrors on the vanished id (the reference
+    # keeps dead brokers in the model as State.DEAD, ClusterModel.java:930).
+    # The rack is unknown once the broker is gone — use a per-broker
+    # placeholder (rack goals already ignore dead brokers as destinations).
+    for b in sorted(dead_ids):
+        brokers.append(BrokerInfo(broker_id=b, rack=f"rack-{b}", is_alive=False))
+    return ClusterMetadata(brokers=tuple(brokers), partitions=tuple(partitions))
 
 
 class KafkaMetadataRefresher:
@@ -58,5 +68,12 @@ class KafkaMetadataRefresher:
             if force or now - self._last >= self._ttl_s:
                 fresh = cluster_metadata_from_kafka(self._client, self._exclude)
                 self._last = now
-                return self._md.refresh(fresh)
+                cur = self._md.cluster()
+                # Only an actual topology change advances the generation —
+                # model/proposal caches key on it (LongGenerationed semantics;
+                # an unconditional bump would invalidate them every TTL).
+                import dataclasses
+                if dataclasses.replace(fresh, generation=0) != \
+                        dataclasses.replace(cur, generation=0):
+                    return self._md.refresh(fresh)
             return self._md.cluster()

@@ -305,13 +305,25 @@ def decode_record_batches(data: bytes) -> List[Record]:
             batch_len = r.i32()
             if r.remaining() < batch_len:
                 break  # truncated trailing batch (Fetch may cut mid-batch)
-            body = Reader(r.raw(batch_len))
+            raw_body = r.raw(batch_len)
+            body = Reader(raw_body)
             body.i32()            # partition leader epoch
             magic = body.i8()
             if magic != 2:
                 continue
-            body.u32()            # crc (trusted: local/fake brokers)
-            body.i16()            # attributes
+            crc = body.u32()
+            if crc32c(raw_body[9:]) != crc:
+                raise ValueError(
+                    f"record batch CRC mismatch at offset {base_offset}")
+            attrs = body.i16()
+            if attrs & 0x7:
+                # gzip/snappy/lz4/zstd payloads would decode as garbage —
+                # fail loudly (the reference consumer decompresses; this
+                # build's producers always write uncompressed batches).
+                raise ValueError(
+                    f"compressed record batch (codec {attrs & 0x7}) "
+                    "unsupported — configure the metrics topic/producer "
+                    "with compression.type=none")
             body.i32()            # last offset delta
             base_ts = body.i64()
             body.i64()            # max ts

@@ -1,0 +1,104 @@
+"""MetricSampler consuming the ``__CruiseControlMetrics`` topic.
+
+Parity with ``CruiseControlMetricsReporterSampler``
+(monitor/sampling/CruiseControlMetricsReporterSampler.java:36): each
+``get_samples`` call drains new records from every partition of the metrics
+topic, decodes them with the reporter serde, keeps those inside the
+requested time range, and feeds the processor to derive partition/broker
+samples.  Consumption is offset-tracked per partition (no consumer groups —
+the sampler is the topic's only reader, as in the reference's
+assign-and-seek consumer).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+from cruise_control_tpu.kafka.client import KafkaClient, KafkaError
+from cruise_control_tpu.monitor.metadata import ClusterMetadata
+from cruise_control_tpu.monitor.metrics_processor import CruiseControlMetricsProcessor
+from cruise_control_tpu.monitor.sampling import (MetricSampler, Samples,
+                                                 SamplingMode)
+from cruise_control_tpu.reporter.agent import METRICS_TOPIC
+from cruise_control_tpu.reporter.serde import MetricSerdeError, decode_metric
+
+Tp = Tuple[str, int]
+
+
+class KafkaMetricSampler(MetricSampler):
+    def __init__(self, client: KafkaClient, topic: str = METRICS_TOPIC,
+                 max_polls_per_partition: int = 100):
+        self._client = client
+        self._topic = topic
+        self._offsets: Dict[int, int] = {}  # metrics-topic partition → next offset
+        self._max_polls = max_polls_per_partition
+        self._processor = CruiseControlMetricsProcessor()
+        # Records fetched ahead of their sampling window (time_ms >= end_ms):
+        # consuming advances offsets permanently, so they must be carried to
+        # the NEXT get_samples call, not dropped (bootstrap replays windows
+        # sequentially and would otherwise only ever ingest the first one).
+        self._holdover: List = []
+
+    def _route_metric(self, metric, start_ms: int, end_ms: int) -> None:
+        """In-window → processor; future → holdover for the next window;
+        older than start → genuinely late, dropped (reference sampler
+        semantics)."""
+        if metric.time_ms >= end_ms:
+            self._holdover.append(metric)
+        elif metric.time_ms >= start_ms:
+            self._processor.add_metric(metric)
+
+    def _metric_partitions(self) -> List[int]:
+        md = self._client.metadata([self._topic])
+        return sorted(p.partition for p in md.partitions
+                      if p.topic == self._topic)
+
+    def get_samples(self, cluster: ClusterMetadata,
+                    partitions: Sequence[Tp], start_ms: int, end_ms: int,
+                    mode: SamplingMode = SamplingMode.ALL) -> Samples:
+        try:
+            metric_parts = self._metric_partitions()
+        except (KafkaError, ConnectionError, OSError):
+            return Samples([], [])
+        # Re-examine held-over records first (they were fetched by an earlier
+        # call whose window ended before their timestamps).
+        pending, self._holdover = self._holdover, []
+        for metric in pending:
+            self._route_metric(metric, start_ms, end_ms)
+        for mp in metric_parts:
+            offset = self._offsets.get(mp)
+            if offset is None:
+                offset = self._client.list_offset((self._topic, mp), -2)
+            for _ in range(self._max_polls):
+                try:
+                    records, hwm = self._client.fetch((self._topic, mp), offset)
+                except ValueError:
+                    # Poisoned batch (compressed / CRC mismatch): skip the
+                    # partition to its high watermark rather than wedging
+                    # sampling on the same offset forever.
+                    offset = self._client.list_offset((self._topic, mp), -1)
+                    break
+                if not records:
+                    break
+                for rec in records:
+                    offset = max(offset, rec.offset + 1)
+                    if rec.value is None:
+                        continue
+                    try:
+                        metric = decode_metric(rec.value)
+                    except MetricSerdeError:
+                        continue  # skip foreign/corrupt records, keep going
+                    self._route_metric(metric, start_ms, end_ms)
+                if offset >= hwm:
+                    break
+            self._offsets[mp] = offset
+
+        want_partitions = mode in (SamplingMode.ALL,
+                                   SamplingMode.PARTITION_METRICS_ONLY,
+                                   SamplingMode.ONGOING_EXECUTION)
+        want_brokers = mode in (SamplingMode.ALL,
+                                SamplingMode.BROKER_METRICS_ONLY)
+        samples = self._processor.process(cluster, partitions,
+                                          time_ms=end_ms - 1)
+        return Samples(samples.partition_samples if want_partitions else [],
+                       samples.broker_samples if want_brokers else [])

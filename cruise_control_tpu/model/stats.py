@@ -44,21 +44,26 @@ class ClusterModelStats:
     num_replicas: Array
 
     def to_dict(self) -> Dict[str, object]:
+        import numpy as np
+
+        # One batched fetch for all 16 leaves (per-leaf np.asarray is one
+        # device round trip each on a tunneled TPU).
+        host = jax.device_get(self)
+
         def ser(x):
-            import numpy as np
             arr = np.asarray(x)
             return arr.item() if arr.ndim == 0 else arr.tolist()
 
         out = {}
         for name in ("resource_util_mean", "resource_util_max", "resource_util_min",
                      "resource_util_std"):
-            vals = ser(getattr(self, name))
+            vals = ser(getattr(host, name))
             out[name] = {r.resource_name: vals[r.value] for r in Resource}
         for name in ("replica_count_mean", "replica_count_max", "replica_count_min",
                      "replica_count_std", "leader_count_mean", "leader_count_max",
                      "leader_count_min", "leader_count_std", "potential_nw_out_mean",
                      "potential_nw_out_max", "num_alive_brokers", "num_replicas"):
-            out[name] = ser(getattr(self, name))
+            out[name] = ser(getattr(host, name))
         return out
 
 

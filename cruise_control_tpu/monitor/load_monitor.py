@@ -109,6 +109,19 @@ class LoadMonitor:
         # Model-generation semaphore (LoadMonitor.java:92,165): bounds
         # concurrent model builds.
         self._model_semaphore = threading.Semaphore(2)
+        self._monitored_pct_cache: Optional[Tuple[Tuple[int, int], float]] = None
+        # Sensor registrations (LoadMonitor.java:180-195; Sensors.md:
+        # valid-windows, monitored-partitions-percentage,
+        # total-monitored-windows, cluster-model-creation-timer).
+        from cruise_control_tpu.common.sensors import SENSORS
+        SENSORS.gauge("LoadMonitor.valid-windows",
+                      lambda: self.partition_aggregator.valid_windows())
+        SENSORS.gauge("LoadMonitor.monitored-partitions-percentage",
+                      self.monitored_partitions_percentage)
+        SENSORS.gauge("LoadMonitor.total-monitored-windows",
+                      lambda: self.partition_aggregator.num_windows)
+        self._model_timer = SENSORS.timer(
+            "LoadMonitor.cluster-model-creation-timer")
 
     # -- lifecycle / state -------------------------------------------------
     def start_up(self, skip_loading_samples: bool = False) -> None:
@@ -184,11 +197,19 @@ class LoadMonitor:
 
     # -- completeness ------------------------------------------------------
     def monitored_partitions_percentage(self) -> float:
+        # Generation-cached: this is a sensor read on the /state and
+        # /metrics hot paths, and a full window aggregation per scrape is a
+        # heavyweight recomputation at the 1M-replica scale.
+        gen = (self._metadata.cluster().generation,
+               self.partition_aggregator.generation)
+        cached = self._monitored_pct_cache
+        if cached is not None and cached[0] == gen:
+            return cached[1]
         agg = self.partition_aggregator.aggregate()
         total = self._metadata.cluster().partition_count()
-        if total == 0:
-            return 0.0
-        return float(agg.entity_valid.sum()) / total
+        pct = float(agg.entity_valid.sum()) / total if total else 0.0
+        self._monitored_pct_cache = (gen, pct)
+        return pct
 
     def meets_completeness_requirements(self, req: ModelCompletenessRequirements) -> bool:
         if self.partition_aggregator.valid_windows() < req.min_required_num_windows:
@@ -217,7 +238,7 @@ class LoadMonitor:
         not a fresh ``naming()`` read — membership can change mid-operation
         and would silently misaddress every proposal."""
         req = requirements or ModelCompletenessRequirements()
-        with self._model_semaphore:
+        with self._model_semaphore, self._model_timer.time():
             cluster = self._metadata.cluster()
             if self.partition_aggregator.valid_windows() < req.min_required_num_windows:
                 raise NotEnoughValidWindowsError(

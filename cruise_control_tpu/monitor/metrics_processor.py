@@ -1,0 +1,175 @@
+"""Raw reporter metrics → derived metric samples.
+
+Parity with ``CruiseControlMetricsProcessor``
+(monitor/sampling/CruiseControlMetricsProcessor.java:36) +
+``SamplingUtils.estimateLeaderCpuUtil`` (sampling/SamplingUtils.java:84-111):
+turn the raw per-broker / per-topic / per-partition records the reporter
+produced into ``PartitionMetricSample`` / ``BrokerMetricSample`` rows the
+aggregator consumes.
+
+Semantics carried over:
+
+- Topic-level byte rates are reported per broker (each broker reports the
+  rates of the partitions it leads); the processor splits a broker's topic
+  rate evenly across that broker's leader partitions of the topic.
+- Per-partition CPU is estimated from broker CPU weighted by the
+  partition's share of the broker's total bytes in+out
+  (ModelUtils.estimateLeaderCpuUtilPerCore, model/ModelUtils.java:92).
+- Missing-metric tolerance (holder/BrokerLoad.java:243): partitions without
+  a size sample and brokers without a CPU sample are skipped, not invented.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from cruise_control_tpu.monitor.metadata import ClusterMetadata
+from cruise_control_tpu.monitor.sampling import (BrokerMetricSample,
+                                                 PartitionMetricSample, Samples)
+from cruise_control_tpu.reporter.raw_metrics import RawMetric, RawMetricType
+
+Tp = Tuple[str, int]
+
+# RawMetricType → broker-sample metric name (KAFKA_METRIC_DEF).
+_BROKER_METRIC_NAMES: Dict[RawMetricType, str] = {
+    RawMetricType.BROKER_PRODUCE_REQUEST_RATE: "BROKER_PRODUCE_REQUEST_RATE",
+    RawMetricType.BROKER_CONSUMER_FETCH_REQUEST_RATE:
+        "BROKER_CONSUMER_FETCH_REQUEST_RATE",
+    RawMetricType.BROKER_FOLLOWER_FETCH_REQUEST_RATE:
+        "BROKER_FOLLOWER_FETCH_REQUEST_RATE",
+    RawMetricType.BROKER_REQUEST_HANDLER_AVG_IDLE_PERCENT:
+        "BROKER_REQUEST_HANDLER_POOL_IDLE_PERCENT",
+    RawMetricType.BROKER_REQUEST_QUEUE_SIZE: "BROKER_REQUEST_QUEUE_SIZE",
+    RawMetricType.BROKER_RESPONSE_QUEUE_SIZE: "BROKER_RESPONSE_QUEUE_SIZE",
+    RawMetricType.BROKER_PRODUCE_REQUEST_QUEUE_TIME_MS_MAX:
+        "BROKER_PRODUCE_REQUEST_QUEUE_TIME_MS_MAX",
+    RawMetricType.BROKER_PRODUCE_REQUEST_QUEUE_TIME_MS_MEAN:
+        "BROKER_PRODUCE_REQUEST_QUEUE_TIME_MS_MEAN",
+    RawMetricType.BROKER_CONSUMER_FETCH_REQUEST_QUEUE_TIME_MS_MAX:
+        "BROKER_CONSUMER_FETCH_REQUEST_QUEUE_TIME_MS_MAX",
+    RawMetricType.BROKER_CONSUMER_FETCH_REQUEST_QUEUE_TIME_MS_MEAN:
+        "BROKER_CONSUMER_FETCH_REQUEST_QUEUE_TIME_MS_MEAN",
+    RawMetricType.BROKER_FOLLOWER_FETCH_REQUEST_QUEUE_TIME_MS_MAX:
+        "BROKER_FOLLOWER_FETCH_REQUEST_QUEUE_TIME_MS_MAX",
+    RawMetricType.BROKER_FOLLOWER_FETCH_REQUEST_QUEUE_TIME_MS_MEAN:
+        "BROKER_FOLLOWER_FETCH_REQUEST_QUEUE_TIME_MS_MEAN",
+    RawMetricType.BROKER_LOG_FLUSH_RATE: "BROKER_LOG_FLUSH_RATE",
+    RawMetricType.BROKER_LOG_FLUSH_TIME_MS_MAX: "BROKER_LOG_FLUSH_TIME_MS_MAX",
+    RawMetricType.BROKER_LOG_FLUSH_TIME_MS_MEAN: "BROKER_LOG_FLUSH_TIME_MS_MEAN",
+    RawMetricType.BROKER_LOG_FLUSH_TIME_MS_999TH:
+        "BROKER_LOG_FLUSH_TIME_MS_999TH",
+}
+
+BYTES_TO_KB = 1.0 / 1024.0
+BYTES_TO_MB = 1.0 / (1024.0 * 1024.0)
+
+
+class CruiseControlMetricsProcessor:
+    """Accumulates raw metrics, then derives samples against a metadata
+    snapshot (process() clears the accumulation)."""
+
+    def __init__(self):
+        self._raw: List[RawMetric] = []
+
+    def add_metric(self, metric: RawMetric) -> None:
+        self._raw.append(metric)
+
+    def add_metrics(self, metrics: Iterable[RawMetric]) -> None:
+        self._raw.extend(metrics)
+
+    def pending(self) -> int:
+        return len(self._raw)
+
+    def process(self, cluster: ClusterMetadata,
+                partitions: Optional[Iterable[Tp]] = None,
+                time_ms: Optional[int] = None) -> Samples:
+        raw, self._raw = self._raw, []
+        want = set(tuple(tp) for tp in partitions) if partitions is not None \
+            else None
+
+        # ---- bucket raw metrics ------------------------------------------
+        broker_cpu: Dict[int, float] = {}
+        broker_all_bytes: Dict[int, float] = {}    # in + out, bytes/s
+        broker_health: Dict[int, Dict[str, float]] = {}
+        topic_rates: Dict[Tuple[int, str], Dict[RawMetricType, float]] = {}
+        partition_size: Dict[Tp, float] = {}
+        latest_ms = 0
+        for m in raw:
+            latest_ms = max(latest_ms, m.time_ms)
+            t = m.metric_type
+            if t == RawMetricType.BROKER_CPU_UTIL:
+                broker_cpu[m.broker_id] = m.value
+            elif t in (RawMetricType.ALL_TOPIC_BYTES_IN,
+                       RawMetricType.ALL_TOPIC_BYTES_OUT):
+                broker_all_bytes[m.broker_id] = \
+                    broker_all_bytes.get(m.broker_id, 0.0) + m.value
+            elif t in _BROKER_METRIC_NAMES:
+                broker_health.setdefault(m.broker_id, {})[
+                    _BROKER_METRIC_NAMES[t]] = m.value
+            elif t.name.startswith("TOPIC_"):
+                topic_rates.setdefault((m.broker_id, m.topic), {})[t] = m.value
+            elif t == RawMetricType.PARTITION_SIZE:
+                partition_size[(m.topic, m.partition)] = m.value
+        ts = time_ms if time_ms is not None else latest_ms
+
+        # ---- leader partitions per (broker, topic) -----------------------
+        leaders: Dict[Tuple[int, str], List[Tp]] = {}
+        leader_of: Dict[Tp, int] = {}
+        for p in cluster.partitions:
+            if p.leader < 0:
+                continue
+            leader_of[p.tp] = p.leader
+            leaders.setdefault((p.leader, p.topic), []).append(p.tp)
+
+        def topic_rate(broker: int, topic: str, t: RawMetricType) -> float:
+            return topic_rates.get((broker, topic), {}).get(t, 0.0)
+
+        # ---- partition samples -------------------------------------------
+        psamples: List[PartitionMetricSample] = []
+        for tp, size_bytes in sorted(partition_size.items()):
+            if want is not None and tp not in want:
+                continue
+            leader = leader_of.get(tp)
+            if leader is None:
+                continue  # stale record for a vanished partition
+            n = max(len(leaders.get((leader, tp[0]), [tp])), 1)
+            b_in = topic_rate(leader, tp[0], RawMetricType.TOPIC_BYTES_IN) / n
+            b_out = topic_rate(leader, tp[0], RawMetricType.TOPIC_BYTES_OUT) / n
+            rep_in = topic_rate(leader, tp[0],
+                                RawMetricType.TOPIC_REPLICATION_BYTES_IN) / n
+            rep_out = topic_rate(leader, tp[0],
+                                 RawMetricType.TOPIC_REPLICATION_BYTES_OUT) / n
+            # CPU share ∝ partition's bytes share of the broker's total
+            # (estimateLeaderCpuUtil); even share when rates are absent.
+            total = broker_all_bytes.get(leader, 0.0)
+            if total > 0:
+                share = (b_in + b_out) / total
+            else:
+                share = 1.0 / max(sum(len(v) for (b, _), v in leaders.items()
+                                      if b == leader), 1)
+            cpu = broker_cpu.get(leader, 0.0) * share
+            psamples.append(PartitionMetricSample(
+                topic=tp[0], partition=tp[1], broker_id=leader, time_ms=ts,
+                metrics={
+                    "CPU_USAGE": cpu,
+                    "DISK_USAGE": size_bytes * BYTES_TO_MB,
+                    "LEADER_BYTES_IN": b_in * BYTES_TO_KB,
+                    "LEADER_BYTES_OUT": b_out * BYTES_TO_KB,
+                    "PRODUCE_RATE": topic_rate(
+                        leader, tp[0], RawMetricType.TOPIC_PRODUCE_REQUEST_RATE) / n,
+                    "FETCH_RATE": topic_rate(
+                        leader, tp[0], RawMetricType.TOPIC_FETCH_REQUEST_RATE) / n,
+                    "MESSAGE_IN_RATE": topic_rate(
+                        leader, tp[0], RawMetricType.TOPIC_MESSAGES_IN_PER_SEC) / n,
+                    "REPLICATION_BYTES_IN_RATE": rep_in * BYTES_TO_KB,
+                    "REPLICATION_BYTES_OUT_RATE": rep_out * BYTES_TO_KB,
+                }))
+
+        # ---- broker samples ----------------------------------------------
+        bsamples: List[BrokerMetricSample] = []
+        for b in sorted(broker_cpu):
+            metrics = {"CPU_USAGE": broker_cpu[b]}
+            metrics.update(broker_health.get(b, {}))
+            bsamples.append(BrokerMetricSample(broker_id=b, time_ms=ts,
+                                               metrics=metrics))
+        return Samples(psamples, bsamples)

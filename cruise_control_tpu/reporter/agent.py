@@ -1,0 +1,173 @@
+"""The metrics-reporter agent: the broker-side half of the ingestion path.
+
+Parity with ``CruiseControlMetricsReporter``
+(cruise-control-metrics-reporter/src/main/java/.../CruiseControlMetricsReporter.java:60,88):
+sample the broker's raw metrics every interval and produce serialized
+``RawMetric`` records to the ``__CruiseControlMetrics`` topic, creating the
+topic on startup if missing.  The reference plugs into the broker JVM as a
+``MetricsReporter``; a JVM-free framework cannot live inside the broker
+process, so this agent is a sidecar pulling from a pluggable
+``BrokerMetricsSource`` — everything downstream (topic, serde, sampler,
+processor, aggregator) is unchanged.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Sequence
+
+from cruise_control_tpu.kafka.client import KafkaClient, KafkaError
+from cruise_control_tpu.kafka.protocol import Record
+from cruise_control_tpu.reporter.raw_metrics import RawMetric, RawMetricType
+from cruise_control_tpu.reporter.serde import encode_metric
+
+METRICS_TOPIC = "__CruiseControlMetrics"
+
+
+class BrokerMetricsSource:
+    """SPI: where a broker's raw numbers come from (the reference reads the
+    broker's Yammer/Kafka metrics registry in-process; a sidecar reads a JMX
+    bridge, node stats, or — in tests — a synthetic workload)."""
+
+    def collect(self, broker_id: int, time_ms: int) -> List[RawMetric]:
+        raise NotImplementedError
+
+
+class SyntheticBrokerMetricsSource(BrokerMetricsSource):
+    """Deterministic per-broker workload for tests: stable per-(broker,
+    topic, partition) rates seeded by hash — the sidecar-world analogue of
+    the embedded-broker fixture workloads."""
+
+    def __init__(self, topic_partitions, leaders, cpu_util: float = 0.4,
+                 bytes_in_per_partition: float = 64 * 1024.0,
+                 partition_size_bytes: float = 512 * 1024 * 1024.0):
+        # topic_partitions: {topic: num_partitions}; leaders: {(t, p): broker}
+        self._topics = dict(topic_partitions)
+        self._leaders = dict(leaders)
+        self._cpu = cpu_util
+        self._bin = bytes_in_per_partition
+        self._size = partition_size_bytes
+
+    def _scale(self, topic: str, partition: int) -> float:
+        h = hash(("smet", topic, partition)) & 0xFFFF
+        return 0.5 + (h / 0xFFFF)
+
+    def collect(self, broker_id: int, time_ms: int) -> List[RawMetric]:
+        out: List[RawMetric] = []
+        total_in = total_out = 0.0
+        for topic, nparts in sorted(self._topics.items()):
+            t_in = t_out = 0.0
+            led_any = False
+            for p in range(nparts):
+                if self._leaders.get((topic, p)) != broker_id:
+                    continue
+                led_any = True
+                s = self._scale(topic, p)
+                t_in += self._bin * s
+                t_out += 1.4 * self._bin * s
+                out.append(RawMetric(RawMetricType.PARTITION_SIZE, time_ms,
+                                     broker_id, self._size * s, topic=topic,
+                                     partition=p))
+            if led_any:
+                out.append(RawMetric(RawMetricType.TOPIC_BYTES_IN, time_ms,
+                                     broker_id, t_in, topic=topic))
+                out.append(RawMetric(RawMetricType.TOPIC_BYTES_OUT, time_ms,
+                                     broker_id, t_out, topic=topic))
+                out.append(RawMetric(RawMetricType.TOPIC_REPLICATION_BYTES_IN,
+                                     time_ms, broker_id, t_in, topic=topic))
+                out.append(RawMetric(RawMetricType.TOPIC_REPLICATION_BYTES_OUT,
+                                     time_ms, broker_id, t_in, topic=topic))
+                out.append(RawMetric(RawMetricType.TOPIC_PRODUCE_REQUEST_RATE,
+                                     time_ms, broker_id, 10.0, topic=topic))
+                out.append(RawMetric(RawMetricType.TOPIC_FETCH_REQUEST_RATE,
+                                     time_ms, broker_id, 14.0, topic=topic))
+                out.append(RawMetric(RawMetricType.TOPIC_MESSAGES_IN_PER_SEC,
+                                     time_ms, broker_id, 100.0, topic=topic))
+                total_in += t_in
+                total_out += t_out
+        out.append(RawMetric(RawMetricType.ALL_TOPIC_BYTES_IN, time_ms,
+                             broker_id, total_in))
+        out.append(RawMetric(RawMetricType.ALL_TOPIC_BYTES_OUT, time_ms,
+                             broker_id, total_out))
+        out.append(RawMetric(RawMetricType.BROKER_CPU_UTIL, time_ms,
+                             broker_id, self._cpu))
+        out.append(RawMetric(RawMetricType.BROKER_REQUEST_QUEUE_SIZE, time_ms,
+                             broker_id, 1.0))
+        out.append(RawMetric(
+            RawMetricType.BROKER_REQUEST_HANDLER_AVG_IDLE_PERCENT, time_ms,
+            broker_id, 0.9))
+        out.append(RawMetric(RawMetricType.BROKER_LOG_FLUSH_TIME_MS_999TH,
+                             time_ms, broker_id, 5.0))
+        return out
+
+
+class MetricsReporterAgent:
+    """One broker's reporter: collect → encode → produce each interval.
+
+    ``report_once`` is the unit the scheduler (or a test) drives; ``run``
+    wraps it in the reference's background-thread loop
+    (CruiseControlMetricsReporter.java:88).
+    """
+
+    def __init__(self, client: KafkaClient, source: BrokerMetricsSource,
+                 broker_id: int, topic: str = METRICS_TOPIC,
+                 topic_partitions: int = 1, interval_ms: int = 10_000):
+        self._client = client
+        self._source = source
+        self._broker_id = broker_id
+        self._topic = topic
+        self._topic_partitions = topic_partitions
+        self._interval_s = interval_ms / 1000.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._ensured = False
+
+    def ensure_topic(self) -> None:
+        """Create the metrics topic if missing (reporter startup,
+        CruiseControlMetricsReporter.java maybeCreateTopic)."""
+        if self._ensured:
+            return
+        errors = self._client.create_topics(
+            {self._topic: (self._topic_partitions, 1)},
+            configs={self._topic: {"retention.ms": "3600000",
+                                   "compression.type": "none"}})
+        code = errors.get(self._topic, 0)
+        if code not in (0, 36):  # 36 = TOPIC_ALREADY_EXISTS
+            raise KafkaError(code, f"creating {self._topic}")
+        self._ensured = True
+
+    def report_once(self, time_ms: Optional[int] = None) -> int:
+        """Collect and produce one round of metrics; returns #records."""
+        self.ensure_topic()
+        ts = time_ms if time_ms is not None else int(time.time() * 1000)
+        metrics = self._source.collect(self._broker_id, ts)
+        if not metrics:
+            return 0
+        # All of one broker's records go to one partition (broker_id spread
+        # over the topic's partitions — same keying as the reference).
+        partition = self._broker_id % self._topic_partitions
+        records = [Record(key=str(self._broker_id).encode(),
+                          value=encode_metric(m), timestamp_ms=m.time_ms)
+                   for m in metrics]
+        self._client.produce((self._topic, partition), records)
+        return len(records)
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.report_once()
+            except (KafkaError, ConnectionError, OSError):
+                pass  # transient broker trouble: retry next interval
+            self._stop.wait(self._interval_s)
+
+    def start(self) -> "MetricsReporterAgent":
+        self._thread = threading.Thread(target=self.run, daemon=True,
+                                        name=f"metrics-reporter-{self._broker_id}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)

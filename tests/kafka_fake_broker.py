@@ -164,7 +164,9 @@ class FakeKafkaBroker:
 
     def _metadata(self, r: Reader, w: Writer, v: int) -> None:
         r.array(lambda rr: rr.string())
-        w.array([b for b in self.broker_ids],
+        # Dead brokers disappear from metadata, exactly as in Kafka (their
+        # replicas stay listed in partition replica arrays).
+        w.array([b for b in self.broker_ids if self.alive.get(b)],
                 lambda wr, b: wr.i32(b).string(self.host).i32(self.port)
                 .string(self.racks[b]))
         w.i32(self.broker_ids[0])  # controller
@@ -239,10 +241,12 @@ class FakeKafkaBroker:
                     wp.array([], lambda *_: None)
                     wp.nbytes(None)
                     return
-                # All batches whose base offset + count > requested offset.
-                chunks = [b for b, base in zip(part.log, part.offsets)
-                          if base + 1_000_000_000 > off]
-                data = b"".join(b for b, base in zip(part.log, part.offsets))
+                # Only batches with records at/after the requested offset:
+                # each batch spans [base, next batch's base); the last one
+                # ends at next_offset.
+                ends = part.offsets[1:] + [part.next_offset]
+                data = b"".join(b for b, end in zip(part.log, ends)
+                                if end > off)
                 wp.i32(pid).i16(0).i64(part.next_offset).i64(part.next_offset)
                 wp.array([], lambda *_: None)  # aborted txns
                 wp.nbytes(data if off < part.next_offset else b"")
@@ -472,6 +476,7 @@ class FakeKafkaBroker:
                 tr.array(part_fn)
             rr.array(topic_fn)
         r.array(dir_fn)
+        w.i32(0)  # throttle (v1)
         by_topic: Dict[str, List[Tuple[int, int]]] = {}
         for t, pid, err in results:
             by_topic.setdefault(t, []).append((pid, err))

@@ -1,0 +1,278 @@
+"""End-to-end ingestion pipeline tests over the fake broker.
+
+The reference round trip being reproduced (CruiseControlMetricsReporterTest:
+reporter → topic → sampler, SURVEY.md §4): a reporter agent per broker
+produces serialized raw metrics to ``__CruiseControlMetrics``; the
+KafkaMetricSampler consumes and processes them into derived samples; the
+LoadMonitor aggregates those into windows and builds a cluster model; the
+KafkaSampleStore checkpoints derived samples to Kafka topics and replays
+them for warm start.
+"""
+
+import pytest
+
+from cruise_control_tpu.kafka.client import KafkaClient
+from cruise_control_tpu.kafka.metadata import cluster_metadata_from_kafka
+from cruise_control_tpu.kafka.sample_store import KafkaSampleStore
+from cruise_control_tpu.kafka.sampler import KafkaMetricSampler
+from cruise_control_tpu.monitor.capacity import StaticCapacityResolver
+from cruise_control_tpu.monitor.load_monitor import LoadMonitor
+from cruise_control_tpu.monitor.metadata import MetadataClient
+from cruise_control_tpu.monitor.metrics_processor import CruiseControlMetricsProcessor
+from cruise_control_tpu.monitor.sampling import (BrokerMetricSample,
+                                                 PartitionMetricSample, Samples,
+                                                 SamplingMode)
+from cruise_control_tpu.reporter.agent import (METRICS_TOPIC,
+                                               MetricsReporterAgent,
+                                               SyntheticBrokerMetricsSource)
+from cruise_control_tpu.reporter.raw_metrics import RawMetric, RawMetricType
+from cruise_control_tpu.reporter.serde import (MetricSerdeError, decode_metric,
+                                               encode_metric)
+from tests.kafka_fake_broker import FakeKafkaBroker
+
+W = 300_000
+
+
+@pytest.fixture
+def broker():
+    b = FakeKafkaBroker(num_brokers=3).start()
+    b.create_topic("payload", partitions=6, rf=2)
+    yield b
+    b.stop()
+
+
+@pytest.fixture
+def client(broker):
+    c = KafkaClient([(broker.host, broker.port)], timeout_s=5.0)
+    yield c
+    c.close()
+
+
+def _leaders(broker):
+    return {(t, p): part.leader for t, parts in broker.topics.items()
+            for p, part in parts.items()}
+
+
+def _agents(broker, client):
+    topics = {"payload": 6}
+    source = SyntheticBrokerMetricsSource(topics, _leaders(broker))
+    return [MetricsReporterAgent(client, source, broker_id=b)
+            for b in broker.broker_ids]
+
+
+# ---------------------------------------------------------------------------
+# serde
+# ---------------------------------------------------------------------------
+
+def test_serde_roundtrip_all_scopes():
+    for m in (RawMetric(RawMetricType.BROKER_CPU_UTIL, 1, 0, 0.5),
+              RawMetric(RawMetricType.TOPIC_BYTES_IN, 2, 1, 9.5, topic="tø"),
+              RawMetric(RawMetricType.PARTITION_SIZE, 3, 2, 1e9, topic="t",
+                        partition=7)):
+        assert decode_metric(encode_metric(m)) == m
+
+
+def test_serde_rejects_bad_records():
+    with pytest.raises(MetricSerdeError):
+        decode_metric(b"")
+    with pytest.raises(MetricSerdeError):
+        decode_metric(b"\x07" + b"\x00" * 40)  # bad version
+    good = bytearray(encode_metric(
+        RawMetric(RawMetricType.BROKER_CPU_UTIL, 1, 0, 0.5)))
+    good[1] = 250  # unknown metric type id
+    with pytest.raises(MetricSerdeError):
+        decode_metric(bytes(good))
+    # topic-scoped type framed without a topic → MetricSerdeError, not
+    # a bare ValueError (consumers skip on MetricSerdeError).
+    raw = bytearray(encode_metric(
+        RawMetric(RawMetricType.TOPIC_BYTES_IN, 1, 0, 1.0, topic="t")))
+    raw[-3:] = b""  # drop the topic bytes
+    import struct
+    raw[28:30] = struct.pack(">H", 0)
+    with pytest.raises(MetricSerdeError):
+        decode_metric(bytes(raw))
+
+
+# ---------------------------------------------------------------------------
+# processor
+# ---------------------------------------------------------------------------
+
+def test_processor_derives_partition_cpu_and_rates(client, broker):
+    snapshot = cluster_metadata_from_kafka(client, exclude_topics=())
+    proc = CruiseControlMetricsProcessor()
+    # Broker 0 leads payload/0 (fake assigns round-robin: partition p led by
+    # broker p % 3).
+    proc.add_metrics([
+        RawMetric(RawMetricType.BROKER_CPU_UTIL, 10, 0, 0.6),
+        RawMetric(RawMetricType.ALL_TOPIC_BYTES_IN, 10, 0, 3000.0),
+        RawMetric(RawMetricType.ALL_TOPIC_BYTES_OUT, 10, 0, 3000.0),
+        RawMetric(RawMetricType.TOPIC_BYTES_IN, 10, 0, 2048.0, topic="payload"),
+        RawMetric(RawMetricType.TOPIC_BYTES_OUT, 10, 0, 4096.0, topic="payload"),
+        RawMetric(RawMetricType.PARTITION_SIZE, 10, 0, 1024.0 ** 2,
+                  topic="payload", partition=0),
+        RawMetric(RawMetricType.PARTITION_SIZE, 10, 0, 2 * 1024.0 ** 2,
+                  topic="payload", partition=3),
+    ])
+    samples = proc.process(snapshot)
+    assert proc.pending() == 0
+    ps = {(s.topic, s.partition): s for s in samples.partition_samples}
+    assert set(ps) == {("payload", 0), ("payload", 3)}
+    s0 = ps[("payload", 0)]
+    # broker 0 leads partitions 0 and 3 of payload → topic rate split by 2
+    assert s0.metrics["LEADER_BYTES_IN"] == pytest.approx(1024.0 / 1024)
+    assert s0.metrics["LEADER_BYTES_OUT"] == pytest.approx(2048.0 / 1024)
+    assert s0.metrics["DISK_USAGE"] == pytest.approx(1.0)
+    # CPU split by bytes share: each partition gets (1024+2048)/6000 of 0.6
+    assert s0.metrics["CPU_USAGE"] == pytest.approx(0.6 * 3072 / 6000)
+    bs = {s.broker_id: s for s in samples.broker_samples}
+    assert bs[0].metrics["CPU_USAGE"] == pytest.approx(0.6)
+
+
+def test_processor_skips_unsized_partitions(client, broker):
+    snapshot = cluster_metadata_from_kafka(client)
+    proc = CruiseControlMetricsProcessor()
+    proc.add_metric(RawMetric(RawMetricType.TOPIC_BYTES_IN, 10, 0, 100.0,
+                              topic="payload"))
+    samples = proc.process(snapshot)
+    assert samples.partition_samples == []
+
+
+# ---------------------------------------------------------------------------
+# reporter agent → topic → sampler
+# ---------------------------------------------------------------------------
+
+def test_reporter_creates_topic_and_produces(client, broker):
+    agent = _agents(broker, client)[0]
+    n = agent.report_once(time_ms=5)
+    assert n > 0
+    assert METRICS_TOPIC in broker.topics
+    cfg = broker.configs.get((2, METRICS_TOPIC), {})
+    assert cfg.get("compression.type") == "none"
+    records, hwm = client.fetch((METRICS_TOPIC, 0), 0)
+    assert hwm == n
+    decoded = [decode_metric(r.value) for r in records]
+    assert any(m.metric_type == RawMetricType.BROKER_CPU_UTIL for m in decoded)
+    assert all(m.broker_id == broker.broker_ids[0] for m in decoded)
+
+
+def test_reporter_to_sampler_roundtrip(client, broker):
+    for agent in _agents(broker, client):
+        agent.report_once(time_ms=100)
+    sampler = KafkaMetricSampler(client)
+    snapshot = cluster_metadata_from_kafka(
+        client, exclude_topics=(METRICS_TOPIC,))
+    tps = [p.tp for p in snapshot.partitions if p.topic == "payload"]
+    samples = sampler.get_samples(snapshot, tps, 0, 1000)
+    assert len(samples.partition_samples) == 6
+    assert len(samples.broker_samples) == 3
+    # Offsets advanced: a second poll with no new records yields nothing.
+    again = sampler.get_samples(snapshot, tps, 0, 1000)
+    assert again.partition_samples == []
+    # New round of reports becomes visible to the next poll.
+    for agent in _agents(broker, client):
+        agent.report_once(time_ms=200)
+    third = sampler.get_samples(snapshot, tps, 0, 1000)
+    assert len(third.partition_samples) == 6
+
+
+def test_sampler_time_range_filter(client, broker):
+    agent = _agents(broker, client)[0]
+    agent.report_once(time_ms=50)
+    agent.report_once(time_ms=5000)
+    sampler = KafkaMetricSampler(client)
+    snapshot = cluster_metadata_from_kafka(client, exclude_topics=(METRICS_TOPIC,))
+    tps = [p.tp for p in snapshot.partitions]
+    samples = sampler.get_samples(snapshot, tps, 0, 1000)
+    # Only the t=50 round is inside the range; the t=5000 records were
+    # consumed but filtered.
+    assert all(s.time_ms < 1000 for s in samples.partition_samples)
+    assert len(samples.broker_samples) == 1
+
+
+def test_sampler_modes(client, broker):
+    for agent in _agents(broker, client):
+        agent.report_once(time_ms=100)
+    sampler = KafkaMetricSampler(client)
+    snapshot = cluster_metadata_from_kafka(client, exclude_topics=(METRICS_TOPIC,))
+    tps = [p.tp for p in snapshot.partitions if p.topic == "payload"]
+    s = sampler.get_samples(snapshot, tps, 0, 1000,
+                            mode=SamplingMode.BROKER_METRICS_ONLY)
+    assert s.partition_samples == [] and len(s.broker_samples) == 3
+
+
+# ---------------------------------------------------------------------------
+# full pipeline: reporter → topic → sampler → aggregator → cluster model
+# ---------------------------------------------------------------------------
+
+def test_full_pipeline_to_cluster_model(client, broker):
+    sampler = KafkaMetricSampler(client)
+    snapshot = cluster_metadata_from_kafka(client, exclude_topics=(METRICS_TOPIC,))
+    mc = MetadataClient(snapshot)
+    lm = LoadMonitor(mc, StaticCapacityResolver(), num_partition_windows=3,
+                     partition_window_ms=W)
+    lm.start_up()
+    agents = _agents(broker, client)
+    for w in range(4):
+        for agent in agents:
+            agent.report_once(time_ms=w * W + 10)
+        lm.fetch_once(sampler, w * W, w * W + 20)
+    model = lm.cluster_model()
+    assert int(model.replica_valid.sum()) == snapshot.replica_count()
+    import numpy as np
+    load = np.asarray(model.broker_load())
+    assert load.sum() > 0  # real load reached the tensor model
+
+
+# ---------------------------------------------------------------------------
+# Kafka-topic sample store: checkpoint + warm start
+# ---------------------------------------------------------------------------
+
+def test_sample_store_roundtrip(client, broker):
+    store = KafkaSampleStore(client)
+    samples = Samples(
+        [PartitionMetricSample("payload", 2, 1, 42,
+                               {"CPU_USAGE": 0.1, "DISK_USAGE": 5.0})],
+        [BrokerMetricSample(1, 42, {"CPU_USAGE": 0.4})])
+    store.store_samples(samples)
+    loaded = store.load_samples()
+    assert loaded.partition_samples == samples.partition_samples
+    assert loaded.broker_samples == samples.broker_samples
+
+
+def test_sample_store_warm_start_rebuilds_windows(client, broker):
+    """Samples persisted by one monitor warm-start a fresh monitor
+    (KafkaSampleStore.loadSamples → skip the cold sampling wait)."""
+    store = KafkaSampleStore(client)
+    snapshot = cluster_metadata_from_kafka(
+        client, exclude_topics=(METRICS_TOPIC,))
+    mc = MetadataClient(snapshot)
+    lm = LoadMonitor(mc, StaticCapacityResolver(), num_partition_windows=3,
+                     partition_window_ms=W, sample_store=store)
+    lm.start_up()
+    sampler = KafkaMetricSampler(client)
+    agents = _agents(broker, client)
+    for w in range(4):
+        for agent in agents:
+            agent.report_once(time_ms=w * W + 10)
+        lm.fetch_once(sampler, w * W, w * W + 20)
+    model1 = lm.cluster_model()
+
+    # Fresh monitor, same store: replay rebuilds the same model without a
+    # single sampler fetch.
+    lm2 = LoadMonitor(MetadataClient(snapshot), StaticCapacityResolver(),
+                      num_partition_windows=3, partition_window_ms=W,
+                      sample_store=store)
+    lm2.start_up()
+    model2 = lm2.cluster_model()
+    import numpy as np
+    assert np.allclose(np.asarray(model1.broker_load()),
+                       np.asarray(model2.broker_load()))
+
+    # skip_loading_samples leaves the fresh monitor cold.
+    lm3 = LoadMonitor(MetadataClient(snapshot), StaticCapacityResolver(),
+                      num_partition_windows=3, partition_window_ms=W,
+                      sample_store=store)
+    lm3.start_up(skip_loading_samples=True)
+    from cruise_control_tpu.monitor.load_monitor import NotEnoughValidWindowsError
+    with pytest.raises(NotEnoughValidWindowsError):
+        lm3.cluster_model()

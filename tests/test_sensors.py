@@ -1,0 +1,103 @@
+"""Sensor registry tests (Sensors.md parity): the documented sensors are
+registered by their components and queryable through /state and /metrics."""
+
+import numpy as np
+
+from cruise_control_tpu.common.sensors import SENSORS, MetricRegistry
+from cruise_control_tpu.monitor.capacity import StaticCapacityResolver
+from cruise_control_tpu.monitor.load_monitor import LoadMonitor
+from cruise_control_tpu.monitor.metadata import (BrokerInfo, ClusterMetadata,
+                                                 MetadataClient, PartitionInfo)
+from cruise_control_tpu.monitor.sampling import SyntheticWorkloadSampler
+
+W = 300_000
+
+
+def make_env(num_brokers=4, parts=8, rf=2, skew=True):
+    rng = np.random.default_rng(5)
+    brokers = tuple(BrokerInfo(i, rack=f"r{i % 2}", host=f"h{i}")
+                    for i in range(num_brokers))
+    w = np.linspace(1.0, 4.0, num_brokers)
+    w = w / w.sum()
+    ps = []
+    for p in range(parts):
+        if skew:
+            reps = tuple(int(x) for x in
+                         rng.choice(num_brokers, rf, replace=False, p=w))
+        else:
+            reps = tuple((p + i) % num_brokers for i in range(rf))
+        ps.append(PartitionInfo("t", p, leader=reps[0], replicas=reps))
+    mc = MetadataClient(ClusterMetadata(brokers=brokers, partitions=tuple(ps)))
+    lm = LoadMonitor(mc, StaticCapacityResolver(), num_partition_windows=3,
+                     partition_window_ms=W)
+    lm.start_up()
+    s = SyntheticWorkloadSampler()
+    for w_i in range(4):
+        lm.fetch_once(s, w_i * W, w_i * W + 1)
+    return mc, lm
+
+
+def test_registry_basics():
+    reg = MetricRegistry()
+    reg.counter("c").inc()
+    reg.counter("c").inc(2)
+    reg.gauge("g").set(7.5)
+    with reg.timer("t").time():
+        pass
+    snap = reg.snapshot()
+    assert snap["c"] == 3
+    assert snap["g"] == 7.5
+    assert snap["t"]["count"] == 1
+    text = reg.prometheus_text()
+    assert "kafka_cruisecontrol_c 3" in text
+    assert "kafka_cruisecontrol_t_count 1" in text
+
+
+def test_monitor_sensors_registered():
+    _, lm = make_env()
+    snap = SENSORS.snapshot()
+    assert snap["LoadMonitor.valid-windows"] >= 1
+    assert snap["LoadMonitor.monitored-partitions-percentage"] == 1.0
+    assert snap["LoadMonitor.total-monitored-windows"] == 3
+    lm.cluster_model()
+    snap = SENSORS.snapshot()
+    assert snap["LoadMonitor.cluster-model-creation-timer"]["count"] >= 1
+
+
+def test_executor_and_optimizer_sensors():
+    from cruise_control_tpu.api.facade import CruiseControl
+    from cruise_control_tpu.executor.admin import InMemoryClusterAdmin
+    from cruise_control_tpu.executor.executor import Executor
+
+    mc, lm = make_env()
+    admin = InMemoryClusterAdmin(mc)
+    ex = Executor(admin, mc)
+    cc = CruiseControl(lm, ex, admin)
+    before = SENSORS.snapshot().get(
+        "GoalOptimizer.proposal-computation-timer", {"count": 0})["count"]
+    result = cc.rebalance(goals=["ReplicaDistributionGoal",
+                                 "LeaderReplicaDistributionGoal"])
+    snap = SENSORS.snapshot()
+    assert snap["GoalOptimizer.proposal-computation-timer"]["count"] == before + 1
+    assert "Executor.execution-in-progress" in snap
+    if result.proposals and not result.dryrun:
+        assert snap["Executor.executions-started"] >= 1
+        assert snap["Executor.tasks-completed"] >= 1
+    # /state carries the registry (facade.state → Sensors section).
+    state = cc.state()
+    assert "Sensors" in state
+    assert "LoadMonitor.valid-windows" in state["Sensors"]
+
+
+def test_anomaly_sensor_counted():
+    from cruise_control_tpu.detector.anomalies import BrokerFailures
+    from cruise_control_tpu.detector.manager import AnomalyDetectorManager
+    from cruise_control_tpu.detector.notifier import SelfHealingNotifier
+
+    mgr = AnomalyDetectorManager(notifier=SelfHealingNotifier(
+        broker_failure_alert_threshold_ms=10**12,
+        broker_failure_self_healing_threshold_ms=10**12))
+    before = SENSORS.snapshot().get("AnomalyDetector.BrokerFailures-rate", 0)
+    mgr._handle(BrokerFailures(detection_time_ms=0, failed_brokers={1: 0}),
+                now_ms=1)
+    assert SENSORS.snapshot()["AnomalyDetector.BrokerFailures-rate"] == before + 1
