@@ -1,0 +1,6 @@
+"""``python -m cruise_control_tpu`` — the process entry point
+(KafkaCruiseControlMain.java:17)."""
+
+from cruise_control_tpu.app import main
+
+main()

@@ -20,11 +20,13 @@ from cruise_control_tpu.model.tensor_model import TensorClusterModel
 
 
 class ActionType:
-    """Reference: analyzer/ActionType.java."""
+    """Reference: analyzer/ActionType.java:24-29."""
 
     INTER_BROKER_REPLICA_MOVEMENT = 0
     LEADERSHIP_MOVEMENT = 1
     INTRA_BROKER_REPLICA_MOVEMENT = 2
+    INTER_BROKER_REPLICA_SWAP = 3
+    INTRA_BROKER_REPLICA_SWAP = 4
 
 
 class ActionAcceptance:
@@ -45,8 +47,14 @@ class Candidates:
     replica: Array  # i32[K] replica being moved / losing leadership
     src: Array  # i32[K] source broker
     dest: Array  # i32[K] destination broker
-    dest_replica: Array  # i32[K] replica gaining leadership (-1 for moves)
+    # For leadership: the replica gaining leadership.  For swaps: the swap
+    # partner moving dest→src (BalancingAction's destinationTp,
+    # analyzer/BalancingAction.java:20).  -1 for plain moves.
+    dest_replica: Array  # i32[K]
     partition: Array  # i32[K]
+    # Swap partner's partition (== partition for non-swaps, so partition-
+    # uniqueness selection passes treat every candidate uniformly).
+    partition2: Array  # i32[K]
     valid: Array  # bool[K]
     delta_src: Array  # f32[K, 4] load change on src broker (≤ 0 typically)
     delta_dest: Array  # f32[K, 4] load change on dest broker
@@ -70,6 +78,12 @@ class Candidates:
 
     def is_intra_move(self) -> Array:
         return self.action_type == ActionType.INTRA_BROKER_REPLICA_MOVEMENT
+
+    def is_swap(self) -> Array:
+        return self.action_type == ActionType.INTER_BROKER_REPLICA_SWAP
+
+    def is_intra_swap(self) -> Array:
+        return self.action_type == ActionType.INTRA_BROKER_REPLICA_SWAP
 
 
 def make_candidates(model: TensorClusterModel, replica_ids: Array, dest_brokers: Array,
@@ -129,6 +143,7 @@ def make_candidates(model: TensorClusterModel, replica_ids: Array, dest_brokers:
         dest=dest.astype(jnp.int32),
         dest_replica=dest_replica.astype(jnp.int32),
         partition=model.replica_partition[r],
+        partition2=model.replica_partition[r],
         valid=valid,
         delta_src=delta_src,
         delta_dest=delta_dest,
@@ -142,8 +157,82 @@ def make_candidates(model: TensorClusterModel, replica_ids: Array, dest_brokers:
     )
 
 
+def make_swap_candidates(model: TensorClusterModel, replica_out: Array,
+                         replica_in: Array, valid: Array,
+                         intra: bool = False) -> Candidates:
+    """K-batch of replica SWAPS: ``replica_out`` (on src) exchanges places
+    with ``replica_in`` (on dest) — INTER_BROKER_REPLICA_SWAP, or the two
+    exchange *disks* on one broker — INTRA_BROKER_REPLICA_SWAP
+    (ActionType.java:24-29; swap application in AbstractGoal.java:281-332).
+
+    Broker-axis delta fields carry the NET effect (out's load leaves src and
+    in's load arrives, and vice versa on dest), so every delta-based kernel
+    (band feasibility, budgets, capacity acceptance) works unchanged; swap-
+    aware kernels special-case rack/topic/leader bookkeeping via
+    ``is_swap()``."""
+    r1 = replica_out
+    r2 = jnp.where(replica_in >= 0, replica_in, 0)
+    k = r1.shape[0]
+
+    src = model.replica_broker[r1]
+    dest = model.replica_broker[r2]
+
+    def load_of(r):
+        return jnp.where(model.replica_is_leader[r][:, None],
+                         model.replica_load_leader[r],
+                         model.replica_load_follower[r])
+
+    l1, l2 = load_of(r1), load_of(r2)
+    lead1 = model.replica_is_leader[r1]
+    lead2 = model.replica_is_leader[r2]
+    if intra:
+        # Same broker: broker-axis deltas are zero; disk axis carries the
+        # exchange (src_disk/dest_disk of r1; kernels read r2 via
+        # dest_replica).
+        delta_src = jnp.zeros_like(l1)
+        delta_dest = jnp.zeros_like(l1)
+        action = jnp.full((k,), ActionType.INTRA_BROKER_REPLICA_SWAP, jnp.int32)
+        d_leader = jnp.zeros((k,), jnp.int32)
+        d_pot = jnp.zeros((k,), jnp.float32)
+        d_lbi_src = jnp.zeros((k,), jnp.float32)
+        d_lbi_dest = jnp.zeros((k,), jnp.float32)
+    else:
+        delta_src = l2 - l1
+        delta_dest = l1 - l2
+        action = jnp.full((k,), ActionType.INTER_BROKER_REPLICA_SWAP, jnp.int32)
+        d_leader = (lead1.astype(jnp.int32) - lead2.astype(jnp.int32))
+        d_pot = model.replica_load_leader[r1, Resource.NW_OUT] - \
+            model.replica_load_leader[r2, Resource.NW_OUT]
+        lbi1 = jnp.where(lead1, model.replica_load_leader[r1, Resource.NW_IN], 0.0)
+        lbi2 = jnp.where(lead2, model.replica_load_leader[r2, Resource.NW_IN], 0.0)
+        d_lbi_src = lbi1 - lbi2
+        d_lbi_dest = lbi1 - lbi2
+
+    return Candidates(
+        action_type=action,
+        replica=r1.astype(jnp.int32),
+        src=src.astype(jnp.int32),
+        dest=dest.astype(jnp.int32),
+        dest_replica=r2.astype(jnp.int32),
+        partition=model.replica_partition[r1],
+        partition2=model.replica_partition[r2],
+        valid=valid & (replica_in >= 0),
+        delta_src=delta_src,
+        delta_dest=delta_dest,
+        # Swaps exchange one replica for one replica: counts are unchanged.
+        d_replica_count=jnp.zeros((k,), jnp.int32),
+        d_leader_count=d_leader,
+        d_potential_nw_out=d_pot,
+        d_leader_bytes_in_src=d_lbi_src,
+        d_leader_bytes_in_dest=d_lbi_dest,
+        src_disk=model.replica_disk[r1],
+        dest_disk=model.replica_disk[r2],
+    )
+
+
 def apply_candidates(model: TensorClusterModel, cand: Candidates, apply_mask: Array) -> TensorClusterModel:
-    """Apply the masked subset of candidates (moves, disk moves, leaderships)."""
+    """Apply the masked subset of candidates (moves, disk moves,
+    leaderships, swaps)."""
     move_mask = apply_mask & cand.is_move()
     model = model.relocate_replicas(cand.replica, cand.dest, move_mask)
     intra_mask = apply_mask & cand.is_intra_move()
@@ -151,4 +240,13 @@ def apply_candidates(model: TensorClusterModel, cand: Candidates, apply_mask: Ar
     lead_mask = apply_mask & cand.is_leadership()
     safe_dest = jnp.where(cand.dest_replica >= 0, cand.dest_replica, cand.replica)
     model = model.relocate_leadership(cand.replica, safe_dest, lead_mask)
+    # Swaps: two relocations per action (AbstractGoal.java:281-332 applies
+    # both legs atomically; scatters are disjoint because selection enforces
+    # partition uniqueness over BOTH partitions).
+    swap_mask = apply_mask & cand.is_swap()
+    model = model.relocate_replicas(cand.replica, cand.dest, swap_mask)
+    model = model.relocate_replicas(safe_dest, cand.src, swap_mask)
+    iswap_mask = apply_mask & cand.is_intra_swap()
+    model = model.relocate_replicas_to_disk(cand.replica, cand.dest_disk, iswap_mask)
+    model = model.relocate_replicas_to_disk(safe_dest, cand.src_disk, iswap_mask)
     return model

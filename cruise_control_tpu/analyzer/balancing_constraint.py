@@ -38,6 +38,9 @@ class BalancingConstraint:
     overprovisioned_min_brokers: int = 3
     overprovisioned_min_extra_racks: int = 2
     fast_mode_per_broker_move_timeout_ms: int = 500
+    # Max actions one broker participates in per batched optimizer step
+    # (moves.per.step; select_batched's rounds × subround lanes).
+    moves_per_broker_step: int = 24
     # MinTopicLeadersPerBrokerGoal (config-static designated-topic ids +
     # required leaders per broker; reference: topics.with.min.leaders.per.broker).
     min_topic_leaders_per_broker: int = 1
@@ -76,6 +79,7 @@ class BalancingConstraint:
             overprovisioned_min_extra_racks=cfg.get_int(C.OVERPROVISIONED_MIN_EXTRA_RACKS_CONFIG),
             fast_mode_per_broker_move_timeout_ms=cfg.get_int(
                 C.FAST_MODE_PER_BROKER_MOVE_TIMEOUT_MS_CONFIG),
+            moves_per_broker_step=cfg.get_int(C.MOVES_PER_STEP_CONFIG),
         )
 
     @classmethod

@@ -21,7 +21,9 @@ import jax
 import jax.numpy as jnp
 from jax import Array
 
-from cruise_control_tpu.analyzer.actions import ActionType, Candidates, make_candidates
+from cruise_control_tpu.analyzer.actions import (ActionType, Candidates,
+                                                 make_candidates,
+                                                 make_swap_candidates)
 from cruise_control_tpu.analyzer.balancing_constraint import BalancingConstraint
 from cruise_control_tpu.analyzer.goals import kernels
 from cruise_control_tpu.analyzer.goals.specs import GoalSpec
@@ -174,6 +176,125 @@ def intra_disk_candidates(spec: GoalSpec, model: TensorClusterModel, arrays: Bro
     valid = src_ok & dest_alive & not_self & model.replica_valid[replica]
     return make_candidates(model, replica, model.replica_broker[replica], action_type,
                            dest_replica, valid, dest_disks=dest_disk)
+
+
+def default_num_swap_sources(model: TensorClusterModel) -> int:
+    return max(1, min(model.num_replicas_padded, 256))
+
+
+def default_num_swap_partners(model: TensorClusterModel) -> int:
+    return max(1, min(model.num_replicas_padded, 16))
+
+
+def swap_candidates(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
+                    constraint: BalancingConstraint, options: OptimizationOptions,
+                    num_out: int, num_in: int) -> Candidates:
+    """K = S_out·S_in inter-broker replica-SWAP candidates.
+
+    The reference's pairwise swap search walks an over-utilized broker's
+    biggest replicas against an under-utilized broker's smallest
+    (ResourceDistributionGoal.rebalanceForBroker :383-440 swap branch;
+    KafkaAssignerDiskUsageDistributionGoal.java:48 is swap-only): here the
+    top out-replicas (goal relevance = pressure × size) cross the top
+    in-replicas (low-metric brokers, small size, so the net transfer sheds
+    load from the over side) and all pairs are masked/scored at once.
+    """
+    relevance = kernels.source_replica_relevance(spec, model, arrays, constraint)
+    _, out_replicas = jax.lax.top_k(relevance, num_out)            # [S1]
+    out_vals = relevance[out_replicas]
+
+    # Swap-in ranking: replicas on brokers with the most headroom under the
+    # goal metric, smaller first (maximizes the net shed of a pair).
+    room = kernels.dest_room(spec, model, arrays, constraint)
+    recv_ok = arrays.alive & ~options.broker_excluded_replica_move
+    room = jnp.where(recv_ok, room, -jnp.inf)
+    metric_res = spec.resource if spec.resource >= 0 else 3
+    size = model.replica_load()[:, metric_res]
+    size_scale = jnp.maximum(size.max(), 1e-9)
+    in_rank = room[model.replica_broker] - size / size_scale
+    in_rank = jnp.where(model.replica_valid, in_rank, -jnp.inf)
+    _, in_replicas = jax.lax.top_k(in_rank, num_in)                # [S2]
+
+    r1 = jnp.repeat(out_replicas, num_in)                          # [K]
+    r2 = jnp.tile(in_replicas, num_out)                            # [K]
+    src_ok = jnp.repeat(out_vals > _NEG, num_in)
+
+    valid = src_ok & _legit_swap_mask(model, arrays, options, r1, r2)
+    return make_swap_candidates(model, r1, r2, valid)
+
+
+def _legit_swap_mask(model: TensorClusterModel, arrays: BrokerArrays,
+                     options: OptimizationOptions, r1: Array, r2: Array) -> Array:
+    """bool[K] — both swap legs are legit moves (GoalUtils.legitMove applied
+    in both directions; swap-specific: distinct partitions, no sibling
+    collisions either way)."""
+    b1 = model.replica_broker[r1]
+    b2 = model.replica_broker[r2]
+    p1 = model.replica_partition[r1]
+    p2 = model.replica_partition[r2]
+
+    both_alive = arrays.alive[b1] & arrays.alive[b2]
+    different = (b1 != b2) & (p1 != p2)
+
+    def no_sibling_on(replica, broker):
+        part = model.replica_partition[replica]
+        sib = model.partition_replicas[part]
+        sib_valid = (sib >= 0) & (sib != replica[:, None])
+        sib_broker = model.replica_broker[jnp.where(sib >= 0, sib, 0)]
+        return ~(sib_valid & (sib_broker == broker[:, None])).any(axis=1)
+
+    topic_ok = ~options.topic_excluded[model.replica_topic[r1]] & \
+        ~options.topic_excluded[model.replica_topic[r2]]
+    dest_ok = ~options.broker_excluded_replica_move[b1] & \
+        ~options.broker_excluded_replica_move[b2]
+    # A swap makes BOTH brokers destinations: under a requested-destination
+    # operation both must be in the requested set, and under
+    # only_move_immigrants both replicas must be movable — the same gates
+    # _legit_move_mask applies to one-way moves.
+    any_requested = options.requested_dest_only.any()
+    requested_ok = ~any_requested | (options.requested_dest_only[b1] &
+                                     options.requested_dest_only[b2])
+    immigrant1 = model.replica_broker[r1] != model.replica_original_broker[r1]
+    immigrant2 = model.replica_broker[r2] != model.replica_original_broker[r2]
+    immigrants_ok = ~options.only_move_immigrants | (immigrant1 & immigrant2)
+    return (model.replica_valid[r1] & model.replica_valid[r2]
+            & both_alive & different
+            & no_sibling_on(r1, b2) & no_sibling_on(r2, b1)
+            & topic_ok & dest_ok & requested_ok & immigrants_ok)
+
+
+def intra_swap_candidates(spec: GoalSpec, model: TensorClusterModel,
+                          arrays: BrokerArrays, constraint: BalancingConstraint,
+                          options: OptimizationOptions, num_out: int,
+                          num_in: int) -> Candidates:
+    """K = S_out·S_in intra-broker disk-SWAP candidates: replicas of the same
+    broker on different disks exchange places (INTRA_BROKER_REPLICA_SWAP;
+    the reference's intra-broker swap variant, AbstractGoal.java:345-424)."""
+    relevance = kernels.source_replica_relevance(spec, model, arrays, constraint)
+    _, out_replicas = jax.lax.top_k(relevance, num_out)
+    out_vals = relevance[out_replicas]
+
+    # Partners: small replicas on low disks of the SAME broker — rank by
+    # disk headroom, prefer small; same-broker is masked below.
+    disk_load = model.disk_load()
+    safe_disk = jnp.maximum(model.replica_disk, 0)
+    size = model.replica_load()[:, 3]
+    size_scale = jnp.maximum(size.max(), 1e-9)
+    in_rank = -disk_load[safe_disk] - size / size_scale
+    in_rank = jnp.where(model.replica_valid & (model.replica_disk >= 0),
+                        in_rank, -jnp.inf)
+    _, in_replicas = jax.lax.top_k(in_rank, num_in)
+
+    r1 = jnp.repeat(out_replicas, num_in)
+    r2 = jnp.tile(in_replicas, num_out)
+    src_ok = jnp.repeat(out_vals > _NEG, num_in)
+
+    same_broker = model.replica_broker[r1] == model.replica_broker[r2]
+    diff_disk = (model.replica_disk[r1] != model.replica_disk[r2]) & \
+        (model.replica_disk[r1] >= 0) & (model.replica_disk[r2] >= 0)
+    valid = src_ok & same_broker & diff_disk & \
+        model.replica_valid[r1] & model.replica_valid[r2] & (r1 != r2)
+    return make_swap_candidates(model, r1, r2, valid, intra=True)
 
 
 def concat_candidates(a: Candidates, b: Candidates) -> Candidates:

@@ -25,6 +25,7 @@ a single fused XLA graph per goal kind; ``GoalSpec`` fields are static.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax import Array
 
@@ -314,14 +315,29 @@ def _replica_rack_conflict(spec: GoalSpec, model: TensorClusterModel) -> Array:
 
 def _move_rack_ok(spec: GoalSpec, model: TensorClusterModel, cand: Candidates) -> Array:
     """bool[K] — replica move does not (re)create a rack violation."""
-    sib, _, sib_rack, sib_valid = _sibling_info(model, cand.replica)
-    dest_rack = model.broker_rack[cand.dest]
+    return _rack_ok_for(spec, model, cand.replica, cand.dest, cand.partition)
+
+
+def _rack_ok_for(spec: GoalSpec, model: TensorClusterModel, replica: Array,
+                 dest: Array, partition: Array) -> Array:
+    """Rack legality of moving ``replica`` onto ``dest`` (one swap leg or a
+    plain move)."""
+    sib, _, sib_rack, sib_valid = _sibling_info(model, replica)
+    dest_rack = model.broker_rack[dest]
     same_as_dest = sib_valid & (sib_rack == dest_rack[:, None])
     if spec.kind == "rack":
         return ~same_as_dest.any(axis=1)
-    rf = model.partition_replication_factor()[cand.partition]
+    rf = model.partition_replication_factor()[partition]
     allowed = jnp.ceil(rf / model.num_racks)
     return (1 + same_as_dest.sum(axis=1)) <= allowed
+
+
+def _swap_rack_ok(spec: GoalSpec, model: TensorClusterModel, cand: Candidates) -> Array:
+    """Both swap legs rack-legal (r1 → dest AND r2 → src)."""
+    r2 = jnp.where(cand.dest_replica >= 0, cand.dest_replica, 0)
+    fwd = _rack_ok_for(spec, model, cand.replica, cand.dest, cand.partition)
+    rev = _rack_ok_for(spec, model, r2, cand.src, cand.partition2)
+    return fwd & rev
 
 
 # ---------------------------------------------------------------------------
@@ -400,7 +416,8 @@ def _min_leader_feasible(model: TensorClusterModel, arrays: BrokerArrays,
 def _intra_disk_feasible(spec: GoalSpec, model: TensorClusterModel,
                          cand: Candidates, constraint: BalancingConstraint) -> Array:
     """Intra-broker disk move out of an over-band (or dead) disk onto a disk
-    of the same broker that stays within band after receiving the replica."""
+    of the same broker that stays within band after receiving the replica —
+    or an intra-broker SWAP whose net exchange brings both disks in band."""
     disk_load = model.disk_load()
     lo_d, up_d = _disk_limits(spec, model, constraint)
     s = jnp.maximum(cand.src_disk, 0)
@@ -410,13 +427,24 @@ def _intra_disk_feasible(spec: GoalSpec, model: TensorClusterModel,
     src_over = disk_load[s] > up_d[s]
     dest_under = disk_load[d] < lo_d[d]
     helps = src_over | dest_under | src_dead
-    dest_ok = (disk_load[d] + contrib <= up_d[d]) & (model.disk_capacity[d] > 0.0)
-    src_stays = (disk_load[s] - contrib >= lo_d[s]) | src_dead | src_over
     same_broker = model.disk_broker[d] == cand.src
     valid_disks = (cand.src_disk >= 0) & (cand.dest_disk >= 0) & \
         (cand.src_disk != cand.dest_disk)
-    return (cand.is_intra_move() & valid_disks & same_broker & helps
-            & dest_ok & src_stays)
+    dest_ok = (disk_load[d] + contrib <= up_d[d]) & (model.disk_capacity[d] > 0.0)
+    src_stays = (disk_load[s] - contrib >= lo_d[s]) | src_dead | src_over
+    move_ok = (cand.is_intra_move() & valid_disks & same_broker & helps
+               & dest_ok & src_stays)
+    # Intra-broker swap: r1 (src disk) exchanges with r2 (dest disk); net
+    # transfer = contrib - contrib2 out of src disk into dest disk.
+    r2 = jnp.where(cand.dest_replica >= 0, cand.dest_replica, 0)
+    contrib2 = model.replica_load()[r2, Resource.DISK]
+    net = contrib - contrib2
+    swap_dest_ok = (disk_load[d] + net <= up_d[d]) & (model.disk_capacity[d] > 0.0)
+    swap_src_ok = ((disk_load[s] - net >= lo_d[s]) | src_dead | src_over) & \
+        ((disk_load[s] - net <= up_d[s]) | (net > 0))
+    swap_ok = (cand.is_intra_swap() & valid_disks & same_broker & helps
+               & swap_dest_ok & swap_src_ok)
+    return move_ok | swap_ok
 
 
 def accepts(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
@@ -435,23 +463,41 @@ def accepts(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
         # Veto actions that starve a designated topic's source broker.
         designated = _designated_topic_mask(model, constraint)
         t = model.replica_topic[cand.replica]
-        loses_leader = cand.is_leadership() | (cand.is_move() & model.replica_is_leader[cand.replica])
+        loses_leader = cand.is_leadership() | \
+            ((cand.is_move() | cand.is_swap()) & model.replica_is_leader[cand.replica])
         tlc = model.topic_leader_counts()
+        need = constraint.min_topic_leaders_per_broker
         starves = designated[t] & loses_leader & \
-            (tlc[t, cand.src] - 1 < constraint.min_topic_leaders_per_broker) & \
-            arrays.alive[cand.src]
-        return ~starves
+            (tlc[t, cand.src] - 1 < need) & arrays.alive[cand.src]
+        # Swap reverse leg: a designated leader r2 leaving dest.
+        r2 = jnp.where(cand.dest_replica >= 0, cand.dest_replica, 0)
+        t2 = model.replica_topic[r2]
+        starves2 = cand.is_swap() & designated[t2] & model.replica_is_leader[r2] & \
+            (tlc[t2, cand.dest] - 1 < need) & arrays.alive[cand.dest]
+        return ~(starves | starves2)
     if kind in ("intra_disk_capacity", "intra_disk_distribution"):
         # Veto moves landing on a disk that would overflow its band.
         disk_load = model.disk_load()
         _, up_d = _disk_limits(spec, model, constraint)
         d = jnp.maximum(cand.dest_disk, 0)
         contrib = model.replica_load()[cand.replica, Resource.DISK]
-        changes_disk = (cand.is_move() | cand.is_intra_move()) & (cand.dest_disk >= 0)
-        over = disk_load[d] + contrib > up_d[d]
-        return ~(changes_disk & over)
+        r2 = jnp.where(cand.dest_replica >= 0, cand.dest_replica, 0)
+        contrib2 = model.replica_load()[r2, Resource.DISK]
+        is_swap = cand.is_swap() | cand.is_intra_swap()
+        # Swap legs: r1 lands on r2's disk (net contrib - contrib2) and r2
+        # lands on r1's disk (net contrib2 - contrib).
+        net_in = jnp.where(is_swap, contrib - contrib2, contrib)
+        changes_disk = (cand.is_move() | cand.is_intra_move() | is_swap) & \
+            (cand.dest_disk >= 0)
+        over = disk_load[d] + net_in > up_d[d]
+        s = jnp.maximum(cand.src_disk, 0)
+        over_rev = is_swap & (cand.src_disk >= 0) & \
+            (disk_load[s] + contrib2 - contrib > up_d[s])
+        return ~((changes_disk & over) | over_rev)
     if kind in ("rack", "rack_distribution"):
-        return jnp.where(cand.is_move(), _move_rack_ok(spec, model, cand), True)
+        return jnp.where(cand.is_move(), _move_rack_ok(spec, model, cand),
+                         jnp.where(cand.is_swap(),
+                                   _swap_rack_ok(spec, model, cand), True))
     if kind == "topic_replica_distribution":
         lower_t, upper_t = _topic_limits(model, arrays, constraint)
         tbc = model.topic_broker_replica_counts()
@@ -459,7 +505,14 @@ def accepts(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
         c_src = tbc[t, cand.src].astype(jnp.float32)
         c_dest = tbc[t, cand.dest].astype(jnp.float32)
         ok = (c_dest + 1 <= upper_t[t]) & (c_src - 1 >= lower_t[t])
-        return jnp.where(cand.is_move(), ok, True)
+        # Swap: r1's topic count shifts src→dest AND r2's dest→src.
+        r2 = jnp.where(cand.dest_replica >= 0, cand.dest_replica, 0)
+        t2 = model.replica_topic[r2]
+        c2_src = tbc[t2, cand.src].astype(jnp.float32)
+        c2_dest = tbc[t2, cand.dest].astype(jnp.float32)
+        swap_ok = ok & (c2_src + 1 <= upper_t[t2]) & (c2_dest - 1 >= lower_t[t2])
+        return jnp.where(cand.is_move(), ok,
+                         jnp.where(cand.is_swap(), swap_ok, True))
     metric = broker_metric(spec, model, arrays, constraint)
     lower, upper = limits(spec, model, arrays, constraint)
     d_src, d_dest = _candidate_deltas(spec, cand)
@@ -505,15 +558,21 @@ def score(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
         s = jnp.maximum(cand.src_disk, 0)
         d = jnp.maximum(cand.dest_disk, 0)
         contrib = model.replica_load()[cand.replica, Resource.DISK]
+        r2 = jnp.where(cand.dest_replica >= 0, cand.dest_replica, 0)
+        contrib2 = model.replica_load()[r2, Resource.DISK]
+        # Net disk transfer: full contribution for a move, the exchange
+        # difference for an intra-broker swap.
+        net = jnp.where(cand.is_intra_swap(), contrib - contrib2, contrib)
 
         def dev(load, disk):
             return jnp.maximum(load - up_d[disk], 0.0) + \
                 jnp.maximum(lo_d[disk] - load, 0.0)
 
         before = dev(disk_load[s], s) + dev(disk_load[d], d)
-        after = dev(disk_load[s] - contrib, s) + dev(disk_load[d] + contrib, d)
+        after = dev(disk_load[s] - net, s) + dev(disk_load[d] + net, d)
         dead_bonus = jnp.where(model.disk_capacity[s] < 0.0, _OFFLINE_BONUS, 0.0)
-        return jnp.where(cand.is_intra_move(), before - after + dead_bonus, 0.0)
+        return jnp.where(cand.is_intra_move() | cand.is_intra_swap(),
+                         before - after + dead_bonus, 0.0)
     if kind in ("rack", "rack_distribution"):
         sib, _, sib_rack, sib_valid = _sibling_info(model, cand.replica)
         own_rack = model.broker_rack[cand.src]
@@ -641,17 +700,46 @@ def source_replica_relevance(spec: GoalSpec, model: TensorClusterModel, arrays: 
         lower_t, upper_t = _topic_limits(model, arrays, constraint)
         tbc = model.topic_broker_replica_counts().astype(jnp.float32)
         c = tbc[model.replica_topic, model.replica_broker]
-        base = jnp.where(c > upper_t[model.replica_topic], 1.0 + pressure, -_BIG)
+        relevant = c > upper_t[model.replica_topic]
+        rank = _within_broker_rank(model, jnp.where(relevant, c, -_BIG))
+        pnorm = pressure / jnp.maximum(jnp.abs(pressure).max(), 1e-9)
+        base = jnp.where(relevant,
+                         -rank.astype(jnp.float32) + 0.5 * pnorm, -_BIG)
     else:
         relevant = pressure > 0
         if kind in ("leader_replica_distribution", "leader_bytes_in"):
             relevant = relevant & model.replica_is_leader
         tiebreak = _replica_metric_contribution(spec, model)
         scale = jnp.maximum(jnp.abs(tiebreak).max(), 1e-9)
-        base = jnp.where(relevant, pressure + 1e-3 * tiebreak / scale, -_BIG)
+        # Breadth-first source diversity: rank each replica WITHIN its broker
+        # (biggest contribution first) and make the rank the dominant key —
+        # the top-S batch then covers every pressured broker's best replicas
+        # instead of one broker's entire replica list.  Pressure-major
+        # ranking serialized shedding one broker at a time (the round-2
+        # verdict's 200+-step ReplicaDistribution tail).
+        rank = _within_broker_rank(model, jnp.where(relevant, tiebreak, -_BIG))
+        pnorm = pressure / jnp.maximum(jnp.abs(pressure).max(), 1e-9)
+        base = jnp.where(relevant,
+                         -rank.astype(jnp.float32) + 0.5 * pnorm
+                         + 1e-3 * tiebreak / scale, -_BIG)
     offline = model.replica_offline_now() | (~arrays.alive[model.replica_broker])
     base = jnp.where(offline, _BIG, base)
     return jnp.where(model.replica_valid, base, -_BIG)
+
+
+def _within_broker_rank(model: TensorClusterModel, key_desc: Array) -> Array:
+    """i32[R] — each replica's position among its broker's replicas when
+    ordered by descending ``key_desc`` (0 = broker's best)."""
+    b = model.replica_broker
+    r = b.shape[0]
+    order = jnp.lexsort((-key_desc, b))  # broker-major, key-desc within
+    b_sorted = b[order]
+    idx = jnp.arange(r, dtype=jnp.int32)
+    is_start = jnp.concatenate([jnp.ones((1,), bool),
+                                b_sorted[1:] != b_sorted[:-1]])
+    seg_start = jax.lax.cummax(jnp.where(is_start, idx, 0))
+    pos_sorted = idx - seg_start
+    return jnp.zeros((r,), jnp.int32).at[order].set(pos_sorted)
 
 
 def _replica_metric_contribution(spec: GoalSpec, model: TensorClusterModel) -> Array:

@@ -29,6 +29,12 @@ class GoalSpec:
     uses_moves: bool = True
     uses_leadership: bool = False
     uses_intra_moves: bool = False
+    # Pairwise exchanges (ActionType INTER/INTRA_BROKER_REPLICA_SWAP): lets
+    # two brokers both near capacity trade a big replica for a small one
+    # when no single move is feasible (ResourceDistributionGoal.java:383-440;
+    # KafkaAssignerDiskUsageDistributionGoal.java:48 is swap-based).
+    uses_swaps: bool = False
+    uses_intra_swaps: bool = False
     # kafka-assigner compatibility mode (kafkaassigner/*.java): same kernel
     # families, flagged so mode-specific goal lists can be assembled.
     kafka_assigner_mode: bool = False
@@ -37,14 +43,20 @@ class GoalSpec:
 def _capacity(name: str, resource: Resource) -> GoalSpec:
     # Reference: goals/CapacityGoal.java:41 + resource bindings
     # (CpuCapacityGoal.java:12, DiskCapacityGoal, NetworkIn/OutboundCapacityGoal).
+    # uses_swaps goes beyond the reference (whose CapacityGoal only moves):
+    # two brokers both near the cap can still trade big-for-small when no
+    # one-way move fits — strictly more fixable states, same invariants.
     return GoalSpec(name=name, kind="capacity", is_hard=True, resource=int(resource),
-                    uses_moves=True, uses_leadership=resource in (Resource.CPU, Resource.NW_OUT))
+                    uses_moves=True, uses_leadership=resource in (Resource.CPU, Resource.NW_OUT),
+                    uses_swaps=True)
 
 
 def _distribution(name: str, resource: Resource) -> GoalSpec:
-    # Reference: goals/ResourceDistributionGoal.java:55 + bindings.
+    # Reference: goals/ResourceDistributionGoal.java:55 + bindings; the
+    # third rebalance mechanism (pairwise swaps, :383-440) is uses_swaps.
     return GoalSpec(name=name, kind="resource_distribution", is_hard=False, resource=int(resource),
-                    uses_moves=True, uses_leadership=resource in (Resource.CPU, Resource.NW_OUT))
+                    uses_moves=True, uses_leadership=resource in (Resource.CPU, Resource.NW_OUT),
+                    uses_swaps=True)
 
 
 GOAL_SPECS: Dict[str, GoalSpec] = {
@@ -90,17 +102,18 @@ GOAL_SPECS: Dict[str, GoalSpec] = {
                                             uses_moves=False, uses_intra_moves=True),
     "IntraBrokerDiskUsageDistributionGoal": GoalSpec(
         "IntraBrokerDiskUsageDistributionGoal", "intra_disk_distribution",
-        uses_moves=False, uses_intra_moves=True),
+        uses_moves=False, uses_intra_moves=True, uses_intra_swaps=True),
     # kafka-assigner compatibility modes (kafkaassigner/
     # KafkaAssignerEvenRackAwareGoal.java:42, round-robin rack-aware placement;
-    # KafkaAssignerDiskUsageDistributionGoal.java:48, swap-based disk
-    # balancing) — mapped onto the rack / disk-distribution kernel families.
+    # KafkaAssignerDiskUsageDistributionGoal.java:48, SWAP-based disk
+    # balancing — pure pairwise exchanges, no one-way moves).
     "KafkaAssignerEvenRackAwareGoal": GoalSpec("KafkaAssignerEvenRackAwareGoal",
                                                "rack", is_hard=True,
                                                kafka_assigner_mode=True),
     "KafkaAssignerDiskUsageDistributionGoal": GoalSpec(
         "KafkaAssignerDiskUsageDistributionGoal", "resource_distribution",
-        resource=int(Resource.DISK), kafka_assigner_mode=True),
+        resource=int(Resource.DISK), kafka_assigner_mode=True,
+        uses_moves=False, uses_swaps=True),
 }
 
 KAFKA_ASSIGNER_GOALS = [n for n, s in GOAL_SPECS.items() if s.kafka_assigner_mode]

@@ -159,18 +159,27 @@ def select_batched(score: Array, cand: Candidates, eligible: Array,
                    model: TensorClusterModel,
                    room_dest: Array, slack_src: Array,
                    topic_guard: bool, disk_guard: bool,
-                   rounds: int = 8) -> Array:
+                   rounds: int = 6, subrounds: int = 4) -> Array:
     """bool[K] — greedy multi-accept subset.
 
     Round-1's selection kept at most ONE action per source broker, per
     destination broker and per partition per step, capping throughput at
     ~B actions/step and pushing distribution goals into a 256-step
-    convergence tail (round-1 verdict item 4).  Here each round keeps one
-    action per src/dest/partition (so within a round all deltas are exact),
-    but across rounds a broker can participate repeatedly as long as the
-    *cumulative* channel deltas stay inside every optimized goal's band
-    (``room_dest`` / ``slack_src``).  Partition uniqueness stays absolute
-    across the whole step — that keeps rack / sibling-table checks exact.
+    convergence tail (round-1 verdict item 4).  Here each round keeps up to
+    ``subrounds`` actions per src / dest broker (candidates are hashed into
+    subround lanes and a segment-argmax runs per (broker, lane)), but across
+    rounds a broker participates only while the *cumulative* channel deltas
+    stay inside every optimized goal's band (``room_dest`` / ``slack_src``).
+    A round's multi-landings are made exact by a violation pass: per-broker
+    sums of the round's kept deltas are checked against the remaining
+    budgets, and a broker whose sum overshoots falls back to its single
+    best action (which passed the per-candidate check by construction).
+    Partition uniqueness stays absolute across the whole step — that keeps
+    rack / sibling-table checks exact.
+
+    Without lanes, a step's throughput was rounds-per-broker (8): the
+    round-2 verdict's 216-step ReplicaDistribution tail at the mid rung was
+    one hot broker shedding 8 replicas per step.
 
     Guards for goals whose metric is finer than a broker channel:
     ``topic_guard`` limits a step to one action per (topic, src) and
@@ -192,6 +201,11 @@ def select_batched(score: Array, cand: Candidates, eligible: Array,
     jitter = ((idx_k * jnp.uint32(2654435761)) >> 12).astype(jnp.float32) / \
         jnp.float32(1 << 20)
     score = score * (1.0 + 1e-4 * jitter)
+    # Subround lane per candidate (decorrelated from the jitter bits).
+    lane = (((idx_k * jnp.uint32(0x9E3779B9)) >> 4) %
+            jnp.uint32(subrounds)).astype(jnp.int32)
+    src_lane = cand.src * subrounds + lane
+    dest_lane = cand.dest * subrounds + lane
     keep_total = jnp.zeros_like(eligible)
     used_part = jnp.zeros((num_partitions,), bool)
     cum_src = jnp.zeros((num_brokers, NUM_CHANNELS), jnp.float32)
@@ -210,7 +224,8 @@ def select_batched(score: Array, cand: Candidates, eligible: Array,
         used_sdisk = jnp.zeros((model.num_disks,), bool)
         used_ddisk = jnp.zeros((model.num_disks,), bool)
     for _ in range(rounds):
-        elig = eligible & ~keep_total & ~used_part[cand.partition]
+        elig = eligible & ~keep_total & ~used_part[cand.partition] & \
+            ~used_part[cand.partition2]
         budget_ok = (
             (cum_dest[cand.dest] + d_dest <= room_dest[cand.dest] + eps) &
             (cum_src[cand.src] + d_src >= -slack_src[cand.src] - eps)
@@ -221,11 +236,51 @@ def select_batched(score: Array, cand: Candidates, eligible: Array,
         if disk_guard:
             touches_disk = cand.dest_disk >= 0
             elig = elig & ~(touches_disk & (used_sdisk[safe_sd] | used_ddisk[safe_dd]))
-        keep = _best_per_segment(score, cand.src, num_brokers, elig)
-        keep = _best_per_segment(score, cand.dest, num_brokers, keep)
+        keep = _best_per_segment(score, src_lane, num_brokers * subrounds, elig)
+        keep = _best_per_segment(score, dest_lane, num_brokers * subrounds, keep)
         keep = _best_per_segment(score, cand.partition, num_partitions, keep)
+        # Swaps involve a second partition — its uniqueness is absolute too.
+        keep = _best_per_segment(score, cand.partition2, num_partitions, keep)
+        # Cross-field collision: the two passes above are per-field, so one
+        # kept candidate's partition2 can still equal ANOTHER's partition
+        # (the same replica would be relocated twice in one round).  Drop
+        # the partition2-claimant of any such pair.
+        claim1 = jnp.zeros((num_partitions,), bool).at[
+            jnp.where(keep, cand.partition, 0)].max(keep)
+        keep = keep & ~((cand.partition2 != cand.partition) &
+                        claim1[cand.partition2])
+        # Guard keys are one-per-STEP: the cross-round `used_*` filters alone
+        # don't stop two lane winners sharing a key inside one round (two
+        # intra moves landing on the same disk oscillate forever).
+        if topic_guard:
+            keep = _best_per_segment(score, ts_key, n_tb, keep)
+            keep = _best_per_segment(score, td_key, n_tb, keep)
+        if disk_guard:
+            touches = cand.dest_disk >= 0
+            kd = _best_per_segment(score, safe_sd, model.num_disks,
+                                   keep & touches)
+            kd = _best_per_segment(score, safe_dd, model.num_disks, kd)
+            keep = (keep & ~touches) | kd
+
+        # Budget-exactness for multi-landings: per-broker sums of this
+        # round's kept deltas vs the REMAINING budgets; a violating broker
+        # falls back to its single best kept action.
+        km = keep[:, None]
+        sum_dest = jnp.zeros_like(cum_dest).at[jnp.where(keep, cand.dest, 0)].add(
+            jnp.where(km, d_dest, 0.0))
+        viol_d = (cum_dest + sum_dest > room_dest + eps).any(axis=1)
+        top1_dest = _best_per_segment(score, cand.dest, num_brokers, keep)
+        keep = keep & (~viol_d[cand.dest] | top1_dest)
+        km = keep[:, None]
+        sum_src = jnp.zeros_like(cum_src).at[jnp.where(keep, cand.src, 0)].add(
+            jnp.where(km, d_src, 0.0))
+        viol_s = (cum_src + sum_src < -slack_src - eps).any(axis=1)
+        top1_src = _best_per_segment(score, cand.src, num_brokers, keep)
+        keep = keep & (~viol_s[cand.src] | top1_src)
+
         keep_total = keep_total | keep
         used_part = used_part.at[jnp.where(keep, cand.partition, 0)].max(keep)
+        used_part = used_part.at[jnp.where(keep, cand.partition2, 0)].max(keep)
         km = keep[:, None]
         cum_src = cum_src.at[jnp.where(keep, cand.src, 0)].add(
             jnp.where(km, d_src, 0.0))
@@ -269,6 +324,17 @@ def _goal_step(model: TensorClusterModel, options: OptimizationOptions,
     if spec.uses_intra_moves:
         batches.append(cgen.intra_disk_candidates(spec, model, arrays, constraint,
                                                   options, num_sources))
+    # Swap widths scale with the (possibly fast-mode / max-candidates
+    # clamped) move widths so the latency/batch-size knobs bound them too.
+    sw_s = min(cgen.default_num_swap_sources(model), num_sources)
+    sw_p = min(cgen.default_num_swap_partners(model),
+               max(2, num_dests), model.num_replicas_padded)
+    if spec.uses_swaps:
+        batches.append(cgen.swap_candidates(
+            spec, model, arrays, constraint, options, sw_s, sw_p))
+    if spec.uses_intra_swaps:
+        batches.append(cgen.intra_swap_candidates(
+            spec, model, arrays, constraint, options, sw_s, sw_p))
     cand = batches[0]
     for extra in batches[1:]:
         cand = cgen.concat_candidates(cand, extra)
@@ -291,8 +357,13 @@ def _goal_step(model: TensorClusterModel, options: OptimizationOptions,
                       for s in all_specs)
     disk_guard = any(s.kind in ("intra_disk_capacity", "intra_disk_distribution")
                      for s in all_specs)
+    # moves.per.step: each round keeps up to `subrounds` actions per broker,
+    # so rounds = ceil(moves_per_broker_step / subrounds).
+    subrounds = 4
+    rounds = max(1, -(-int(constraint.moves_per_broker_step) // subrounds))
     keep = select_batched(score, cand, eligible, model, room_dest, slack_src,
-                          topic_guard, disk_guard)
+                          topic_guard, disk_guard, rounds=rounds,
+                          subrounds=subrounds)
     new_model = apply_candidates(model, cand, keep)
     return new_model, keep.sum()
 
@@ -484,7 +555,9 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
              num_sources: Optional[int] = None, num_dests: Optional[int] = None,
              raise_on_hard_failure: bool = True,
              fused: bool = False,
-             fuse_group_size: Optional[int] = None) -> OptimizerRun:
+             fuse_group_size: Optional[int] = None,
+             fast_mode: bool = False,
+             max_candidates_per_step: Optional[int] = None) -> OptimizerRun:
     """Run the goal stack in priority order (GoalOptimizer.optimizations).
 
     Each goal optimizes the model to its fixpoint, constrained by the
@@ -500,10 +573,23 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
     goals (each its own program, acceptance context carried across): the
     single 15-goal program at 200-broker shapes kernel-faults the TPU
     worker, while the same goals compile and run fine as smaller programs.
+
+    ``fast_mode`` trades proposal quality for latency (the request
+    parameter of OptimizationOptions.java:16; the reference caps per-broker
+    search time, BalancingConstraint.java:36 /
+    ResourceDistributionGoal.java:475-479): narrower candidate batches and
+    a quarter of the step budget per goal.
     """
     constraint = constraint or BalancingConstraint.default()
     options = options if options is not None else OptimizationOptions.none(model)
     specs = goals_by_priority(goal_names)
+    if fast_mode:
+        num_sources = min(max(32, (num_sources or cgen.default_num_sources(model)) // 2),
+                          model.num_replicas_padded)
+        num_dests = max(min(8, model.num_brokers),
+                        min((num_dests or cgen.default_num_dests(model)) // 2,
+                            model.num_brokers))
+        max_steps_per_goal = max(max_steps_per_goal // 4, 16)
 
     # Jitted: ONE runtime dispatch instead of ~30 eager ops (each eager op
     # is an RPC to a tunneled TPU runtime; results stay on device, lazily
@@ -512,6 +598,9 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
     results: List[GoalResult] = []
     ns = num_sources or cgen.default_num_sources(model)
     nd = num_dests or cgen.default_num_dests(model)
+    if max_candidates_per_step:
+        ns = max(1, min(ns, max_candidates_per_step))
+        nd = max(1, min(nd, max_candidates_per_step // ns))
     scored = 0
 
     def k_of(spec: GoalSpec) -> int:
@@ -520,17 +609,25 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
             k += ns * model.max_rf
         if spec.uses_intra_moves:
             k += ns * model.broker_disks.shape[1]
+        if spec.uses_swaps or spec.uses_intra_swaps:
+            k += min(cgen.default_num_swap_sources(model), ns) * \
+                min(cgen.default_num_swap_partners(model), max(2, nd),
+                    model.num_replicas_padded)
         return k
 
     if fused:
         t0 = time.monotonic()
-        # Default chunking is adaptive: one program for small models, chunks
-        # of 5 goals at ≥100 brokers — the single 15-goal program at
-        # 200-broker shapes kernel-faults the TPU worker, and EVERY fused
-        # caller (service facade included) must get the safe default, not
-        # just the bench.
+        # Default chunking is adaptive: one program for small models,
+        # per-goal programs at ≥100 brokers — multi-goal programs at
+        # 200-broker shapes break the tunneled TPU's remote-compile RPC
+        # ("response body closed") and can kernel-fault the worker, while
+        # the same goals compile and run fine one program each.  Chunked
+        # dispatches stay async (one host fetch at the end), so the
+        # round-trip cost of chunking is one transfer regardless of chunk
+        # count.  EVERY fused caller (service facade included) gets the
+        # safe default, not just the bench.
         if fuse_group_size is None and model.num_brokers >= 100:
-            fuse_group_size = 5
+            fuse_group_size = 1
         group = fuse_group_size or len(specs) or 1
         packed_rows = []
         prev: Tuple[GoalSpec, ...] = ()

@@ -85,7 +85,9 @@ class CruiseControl:
                  hard_goals: Optional[Sequence[str]] = None,
                  constraint: Optional[BalancingConstraint] = None,
                  requirements: Optional[ModelCompletenessRequirements] = None,
-                 proposal_expiration_ms: int = 60_000):
+                 proposal_expiration_ms: int = 60_000,
+                 max_steps_per_goal: int = 256,
+                 max_candidates_per_step: Optional[int] = None):
         self.load_monitor = load_monitor
         self.executor = executor
         self.admin = admin
@@ -94,6 +96,8 @@ class CruiseControl:
         self.constraint = constraint or BalancingConstraint.default()
         self.requirements = requirements or ModelCompletenessRequirements()
         self._proposal_expiration_ms = proposal_expiration_ms
+        self._max_steps_per_goal = max_steps_per_goal
+        self._max_candidates_per_step = max_candidates_per_step
         self._cache_lock = threading.Lock()
         self._cached: Optional[Tuple[Tuple[int, int], float, opt.OptimizerRun,
                                      List[props.ExecutionProposal]]] = None
@@ -121,7 +125,8 @@ class CruiseControl:
         return [to_dense[b] for b in broker_ids]
 
     def _optimize(self, model: TensorClusterModel, goals: Optional[Sequence[str]],
-                  options: Optional[OptimizationOptions] = None) -> opt.OptimizerRun:
+                  options: Optional[OptimizationOptions] = None,
+                  fast_mode: bool = False) -> opt.OptimizerRun:
         goal_list = list(goals) if goals else self.goals
         from cruise_control_tpu.common.sensors import SENSORS
         # Requested non-hard-only goal subsets still honor hard goals first
@@ -130,7 +135,9 @@ class CruiseControl:
         with SENSORS.timer("GoalOptimizer.proposal-computation-timer").time():
             return opt.optimize(model, goal_list, constraint=self.constraint,
                                 options=options, raise_on_hard_failure=False,
-                                fused=True)
+                                fused=True, fast_mode=fast_mode,
+                                max_steps_per_goal=self._max_steps_per_goal,
+                                max_candidates_per_step=self._max_candidates_per_step)
 
     def _finish(self, model: TensorClusterModel, run: opt.OptimizerRun,
                 dryrun: bool, reason: str, naming: Dict[str, object],
@@ -219,7 +226,8 @@ class CruiseControl:
     def rebalance(self, goals: Optional[Sequence[str]] = None, dryrun: bool = False,
                   destination_broker_ids: Optional[Sequence[int]] = None,
                   excluded_topics: Optional[Sequence[int]] = None,
-                  reason: str = "rebalance") -> OperationResult:
+                  reason: str = "rebalance",
+                  fast_mode: bool = False) -> OperationResult:
         model, naming = self._model_naming()
         options = OptimizationOptions.none(model)
         if destination_broker_ids:
@@ -230,7 +238,7 @@ class CruiseControl:
             tmask = np.zeros(model.num_topics, bool)
             tmask[list(excluded_topics)] = True
             options = options.replace(topic_excluded=jnp.asarray(tmask))
-        run = self._optimize(model, goals, options)
+        run = self._optimize(model, goals, options, fast_mode=fast_mode)
         return self._finish(model, run, dryrun, reason, naming)
 
     def add_brokers(self, broker_ids: Sequence[int], dryrun: bool = False,

@@ -1,0 +1,127 @@
+"""Pluggable security providers.
+
+Parity with ``servlet/security/`` (SecurityProvider SPI; HTTP Basic in
+server.py): JWT bearer-token auth (security/jwt/JwtSecurityProvider +
+JwtAuthenticator) and trusted-proxy auth (security/trustedproxy/
+TrustedProxySecurityProvider: an authenticated gateway forwards the end
+user in a ``doAs`` parameter).  SPNEGO/Kerberos is out of scope for a
+stdlib-only build (it needs a GSSAPI binding); the SPI seam accepts an
+external provider the same way.
+
+All stdlib: HS256 JWTs via hmac/hashlib/base64.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+from typing import Dict, Iterable, Optional, Tuple
+
+from cruise_control_tpu.api.server import (ROLE_ADMIN, ROLE_USER, ROLE_VIEWER,
+                                           BasicSecurityProvider,
+                                           SecurityProvider)
+
+_ROLES = {ROLE_VIEWER, ROLE_USER, ROLE_ADMIN}
+
+
+def _b64url_decode(part: str) -> bytes:
+    return base64.urlsafe_b64decode(part + "=" * (-len(part) % 4))
+
+
+def _b64url_encode(raw: bytes) -> str:
+    return base64.urlsafe_b64encode(raw).decode().rstrip("=")
+
+
+def encode_jwt(claims: Dict[str, object], secret: bytes) -> str:
+    """Mint an HS256 JWT (test/ops helper — the reference validates tokens
+    minted by an external issuer)."""
+    header = _b64url_encode(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+    body = _b64url_encode(json.dumps(claims).encode())
+    signing_input = f"{header}.{body}".encode()
+    sig = _b64url_encode(hmac.new(secret, signing_input, hashlib.sha256).digest())
+    return f"{header}.{body}.{sig}"
+
+
+class JwtSecurityProvider(SecurityProvider):
+    """Validates ``Authorization: Bearer <jwt>`` (HS256) and maps the token's
+    role claim onto the endpoint role model (security/jwt/)."""
+
+    def __init__(self, secret: bytes, roles_claim: str = "roles",
+                 issuer: Optional[str] = None,
+                 default_role: Optional[str] = None):
+        self._secret = secret
+        self._roles_claim = roles_claim
+        self._issuer = issuer
+        self._default_role = default_role
+
+    def authenticate(self, headers) -> Optional[str]:
+        auth = headers.get("Authorization", "")
+        if not auth.startswith("Bearer "):
+            return None
+        token = auth[7:].strip()
+        try:
+            header_part, body_part, sig_part = token.split(".")
+            header = json.loads(_b64url_decode(header_part))
+            if header.get("alg") != "HS256":
+                return None  # alg confusion (e.g. "none") is rejected
+            signing_input = f"{header_part}.{body_part}".encode()
+            expected = hmac.new(self._secret, signing_input,
+                                hashlib.sha256).digest()
+            if not hmac.compare_digest(expected, _b64url_decode(sig_part)):
+                return None
+            claims = json.loads(_b64url_decode(body_part))
+        except (ValueError, KeyError):
+            return None
+        exp = claims.get("exp")
+        if exp is not None and time.time() > float(exp):
+            return None
+        if self._issuer is not None and claims.get("iss") != self._issuer:
+            return None
+        roles = claims.get(self._roles_claim, [])
+        if isinstance(roles, str):
+            roles = [roles]
+        granted = [r.upper() for r in roles if r.upper() in _ROLES]
+        if not granted:
+            return self._default_role
+        # Highest granted role wins.
+        for role in (ROLE_ADMIN, ROLE_USER, ROLE_VIEWER):
+            if role in granted:
+                return role
+        return None
+
+
+class TrustedProxySecurityProvider(SecurityProvider):
+    """An authenticated gateway makes requests on behalf of end users
+    (security/trustedproxy/): the proxy itself authenticates (HTTP Basic
+    here; SPNEGO in the reference) and names the end user in a
+    ``X-Cruise-Control-Do-As`` header (the servlet's ``doAs`` parameter);
+    the end user's role comes from a local user→role table."""
+
+    DO_AS_HEADER = "X-Cruise-Control-Do-As"
+
+    def __init__(self, proxy_credentials: Dict[str, Tuple[str, str]],
+                 user_roles: Dict[str, str],
+                 allowed_proxies: Optional[Iterable[str]] = None):
+        self._proxy_auth = BasicSecurityProvider(proxy_credentials)
+        self._proxy_names = set(allowed_proxies
+                                if allowed_proxies is not None
+                                else proxy_credentials)
+        self._user_roles = dict(user_roles)
+
+    def authenticate(self, headers) -> Optional[str]:
+        if self._proxy_auth.authenticate(headers) is None:
+            return None
+        auth = headers.get("Authorization", "")
+        try:
+            proxy_user = base64.b64decode(auth[6:]).decode().split(":", 1)[0]
+        except Exception:  # noqa: BLE001
+            return None
+        if proxy_user not in self._proxy_names:
+            return None
+        do_as = headers.get(self.DO_AS_HEADER)
+        if not do_as:
+            return None
+        return self._user_roles.get(do_as)

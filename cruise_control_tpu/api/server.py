@@ -314,12 +314,14 @@ class CruiseControlApi:
         dryrun = _parse_bool(q, "dryrun", True)
         goals = _parse_goals(q)
         dests = _parse_ids(q, "destination_broker_ids")
+        fast = _parse_bool(q, "fast_mode", False)
 
         def fn(progress):
             progress.add_step("GeneratingClusterModel")
             progress.add_step("OptimizationForGoals")
             return self.cc.rebalance(goals=goals, dryrun=dryrun,
-                                     destination_broker_ids=dests or None)
+                                     destination_broker_ids=dests or None,
+                                     fast_mode=fast)
         return self._async("rebalance", q, fn)
 
     def _ep_add_broker(self, q):

@@ -1,0 +1,261 @@
+"""Service assembly + process entry point.
+
+Parity with ``KafkaCruiseControlApp`` (KafkaCruiseControlApp.java:27,36-62:
+component assembly, HTTP connector, servlet wiring) and
+``KafkaCruiseControlMain`` (KafkaCruiseControlMain.java:17:
+``main(propertiesFile, [port], [host])``):
+
+    python -m cruise_control_tpu --config cc.properties [port] [host]
+
+Bindings are config-selected: a non-empty ``bootstrap.servers`` wires the
+wire-protocol Kafka adapters (metadata refresh, KafkaMetricSampler,
+KafkaSampleStore, KafkaClusterAdmin); empty runs fully in-memory (synthetic
+sampler + InMemoryClusterAdmin) — the demo/test mode.  Startup mirrors
+KafkaCruiseControl.startUp (KafkaCruiseControl.java:201-207): sample-store
+replay, sampling scheduler, anomaly detectors, REST server.
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from cruise_control_tpu.config import cruise_control_config
+from cruise_control_tpu.config.configdef import Config, load_properties
+from cruise_control_tpu.config import constants as C
+
+
+def _parse_bootstrap(value: List[str]) -> List[Tuple[str, int]]:
+    out = []
+    for entry in value:
+        host, _, port = entry.rpartition(":")
+        out.append((host or "127.0.0.1", int(port)))
+    return out
+
+
+class KafkaCruiseControlApp:
+    def __init__(self, config: Config, port: Optional[int] = None,
+                 host: Optional[str] = None):
+        self.config = config
+        self._port_override = port
+        self._host_override = host
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._server = None
+        self._kafka_client = None
+        self.port: Optional[int] = None
+        self._build()
+
+    # -- assembly (KafkaCruiseControl ctor, KafkaCruiseControl.java:105-119) --
+    def _build(self) -> None:
+        from cruise_control_tpu.api.facade import CruiseControl
+        from cruise_control_tpu.api.server import (BasicSecurityProvider,
+                                                   CruiseControlApi,
+                                                   SecurityProvider)
+        from cruise_control_tpu.detector.detectors import (BrokerFailureDetector,
+                                                           DiskFailureDetector,
+                                                           GoalViolationDetector)
+        from cruise_control_tpu.detector.manager import AnomalyDetectorManager
+        from cruise_control_tpu.detector.notifier import SelfHealingNotifier
+        from cruise_control_tpu.detector.provisioner import Provisioner
+        from cruise_control_tpu.executor.executor import Executor
+        from cruise_control_tpu.monitor.capacity import BrokerCapacityResolver
+        from cruise_control_tpu.monitor.load_monitor import LoadMonitor
+        from cruise_control_tpu.monitor.metadata import (ClusterMetadata,
+                                                         MetadataClient)
+        from cruise_control_tpu.monitor.sampling import (MetricSampler,
+                                                         SampleStore)
+
+        cfg = self.config
+        bootstrap = _parse_bootstrap(cfg.get(C.BOOTSTRAP_SERVERS_CONFIG))
+        self._refresher = None
+
+        if bootstrap:
+            from cruise_control_tpu.kafka.admin import KafkaClusterAdmin
+            from cruise_control_tpu.kafka.client import KafkaClient
+            from cruise_control_tpu.kafka.metadata import (
+                KafkaMetadataRefresher, cluster_metadata_from_kafka)
+            from cruise_control_tpu.kafka.sample_store import KafkaSampleStore
+            from cruise_control_tpu.kafka.sampler import KafkaMetricSampler
+            from cruise_control_tpu.reporter.agent import METRICS_TOPIC
+
+            self._kafka_client = KafkaClient(bootstrap)
+            internal = (METRICS_TOPIC,)
+            self.metadata_client = MetadataClient(
+                cluster_metadata_from_kafka(self._kafka_client, internal))
+            self._refresher = KafkaMetadataRefresher(
+                self._kafka_client, self.metadata_client,
+                exclude_topics=internal)
+            self.sampler: MetricSampler = KafkaMetricSampler(self._kafka_client)
+            store: SampleStore = KafkaSampleStore(self._kafka_client)
+            self.admin = KafkaClusterAdmin(self._kafka_client)
+        else:
+            from cruise_control_tpu.executor.admin import InMemoryClusterAdmin
+            self.metadata_client = MetadataClient(
+                ClusterMetadata(brokers=(), partitions=()))
+            self.sampler = cfg.get_configured_instance(
+                C.METRIC_SAMPLER_CLASS_CONFIG, MetricSampler)
+            store = cfg.get_configured_instance(
+                C.SAMPLE_STORE_CLASS_CONFIG, SampleStore)
+            self.admin = InMemoryClusterAdmin(self.metadata_client)
+
+        capacity_file = cfg.get(C.CAPACITY_CONFIG_FILE_CONFIG)
+        if capacity_file:
+            from cruise_control_tpu.monitor.capacity import FileCapacityResolver
+            capacity: BrokerCapacityResolver = FileCapacityResolver(capacity_file)
+        else:
+            capacity = cfg.get_configured_instance(
+                C.BROKER_CAPACITY_CONFIG_RESOLVER_CLASS_CONFIG,
+                BrokerCapacityResolver)
+        self.load_monitor = LoadMonitor(
+            self.metadata_client, capacity, sample_store=store,
+            num_partition_windows=cfg.get(C.NUM_PARTITION_METRICS_WINDOWS_CONFIG),
+            partition_window_ms=cfg.get(C.PARTITION_METRICS_WINDOW_MS_CONFIG),
+            num_broker_windows=cfg.get(C.NUM_BROKER_METRICS_WINDOWS_CONFIG),
+            broker_window_ms=cfg.get(C.BROKER_METRICS_WINDOW_MS_CONFIG),
+            min_samples_per_window=cfg.get(
+                C.MIN_SAMPLES_PER_PARTITION_METRICS_WINDOW_CONFIG),
+            max_allowed_extrapolations=cfg.get(
+                C.MAX_ALLOWED_EXTRAPOLATIONS_PER_PARTITION_CONFIG))
+        throttle_rate = cfg.get(C.DEFAULT_REPLICATION_THROTTLE_CONFIG)
+        self.executor = Executor(
+            self.admin, self.metadata_client,
+            throttle_rate_bytes_per_sec=(
+                throttle_rate if throttle_rate and throttle_rate > 0 else None),
+            on_sampling_pause=self.load_monitor.pause_sampling,
+            on_sampling_resume=self.load_monitor.resume_sampling)
+        from cruise_control_tpu.analyzer.balancing_constraint import BalancingConstraint
+        self.cruise_control = CruiseControl(
+            self.load_monitor, self.executor, self.admin,
+            goals=cfg.get(C.DEFAULT_GOALS_CONFIG),
+            hard_goals=cfg.get(C.HARD_GOALS_CONFIG),
+            constraint=BalancingConstraint.from_config(cfg),
+            proposal_expiration_ms=cfg.get(C.PROPOSAL_EXPIRATION_MS_CONFIG),
+            max_steps_per_goal=min(cfg.get(C.MAX_OPTIMIZER_STEPS_CONFIG), 4096),
+            max_candidates_per_step=cfg.get(C.MAX_CANDIDATES_PER_STEP_CONFIG))
+
+        provisioner = cfg.get_configured_instance(
+            C.PROVISIONER_CLASS_CONFIG, Provisioner)
+        self.detector_manager = AnomalyDetectorManager(
+            notifier=SelfHealingNotifier(),
+            facade=self.cruise_control,
+            executor_busy=lambda: self.executor.has_ongoing_execution,
+            history_size=cfg.get(C.NUM_CACHED_RECENT_ANOMALY_STATES_CONFIG))
+        interval = cfg.get(C.ANOMALY_DETECTION_INTERVAL_MS_CONFIG)
+        self.detector_manager.register_detector(
+            GoalViolationDetector(self.load_monitor,
+                                  cfg.get(C.ANOMALY_DETECTION_GOALS_CONFIG),
+                                  provisioner=provisioner), interval)
+        self.detector_manager.register_detector(
+            BrokerFailureDetector(self.metadata_client), interval)
+        self.detector_manager.register_detector(
+            DiskFailureDetector(self.admin, self.metadata_client), interval)
+
+        security: SecurityProvider = SecurityProvider()
+        if cfg.get(C.WEBSERVER_SECURITY_ENABLE_CONFIG):
+            creds_file = cfg.get(C.WEBSERVER_AUTH_CREDENTIALS_FILE_CONFIG)
+            security = BasicSecurityProvider(
+                _load_credentials(creds_file) if creds_file else {})
+        self.api = CruiseControlApi(
+            self.cruise_control, detector_manager=self.detector_manager,
+            sampler=self.sampler,
+            two_step_verification=cfg.get(C.TWO_STEP_VERIFICATION_ENABLED_CONFIG),
+            security=security)
+
+    # -- lifecycle (KafkaCruiseControl.startUp, :201-207) ---------------------
+    def start(self) -> int:
+        from cruise_control_tpu.api.server import serve
+        cfg = self.config
+        self.load_monitor.start_up(
+            skip_loading_samples=cfg.get(C.SKIP_LOADING_SAMPLES_CONFIG))
+
+        sampling_interval_s = cfg.get(C.METRIC_SAMPLING_INTERVAL_MS_CONFIG) / 1000.0
+        detector_interval_s = min(
+            cfg.get(C.ANOMALY_DETECTION_INTERVAL_MS_CONFIG) / 1000.0, 5.0)
+
+        def sampling_loop():
+            while not self._stop.is_set():
+                try:
+                    if self._refresher is not None:
+                        self._refresher.maybe_refresh()
+                    now_ms = int(time.time() * 1000)
+                    self.load_monitor.fetch_once(
+                        self.sampler, now_ms - int(sampling_interval_s * 1000),
+                        now_ms)
+                except Exception:  # noqa: BLE001 — keep the scheduler alive
+                    pass
+                self._stop.wait(sampling_interval_s)
+
+        def detector_loop():
+            while not self._stop.is_set():
+                try:
+                    now_ms = int(time.time() * 1000)
+                    self.detector_manager.run_detectors_once(now_ms)
+                    self.detector_manager.handle_anomalies_once(now_ms)
+                except Exception:  # noqa: BLE001
+                    pass
+                self._stop.wait(detector_interval_s)
+
+        for name, fn in (("cc-sampling", sampling_loop),
+                         ("cc-anomaly-detector", detector_loop)):
+            t = threading.Thread(target=fn, daemon=True, name=name)
+            t.start()
+            self._threads.append(t)
+
+        host = self._host_override or cfg.get(C.WEBSERVER_HTTP_ADDRESS_CONFIG)
+        port = self._port_override
+        if port is None:
+            port = cfg.get(C.WEBSERVER_HTTP_PORT_CONFIG)
+        self._server = serve(self.api, host=host, port=port)
+        self.port = self._server.server_address[1]
+        return self.port
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._server is not None:
+            self._server.shutdown()
+        for t in self._threads:
+            t.join(timeout=5.0)
+        if self._kafka_client is not None:
+            self._kafka_client.close()
+
+
+def _load_credentials(path: str) -> Dict[str, Tuple[str, str]]:
+    """Jetty-style realm file: ``user: password, ROLE``."""
+    creds: Dict[str, Tuple[str, str]] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            user, _, rest = line.partition(":")
+            password, _, role = rest.strip().partition(",")
+            creds[user.strip()] = (password.strip(), role.strip() or "VIEWER")
+    return creds
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    parser = argparse.ArgumentParser(
+        prog="cruise_control_tpu",
+        description="TPU-native Cruise Control service "
+                    "(KafkaCruiseControlMain analogue)")
+    parser.add_argument("--config", required=True,
+                        help="path to a .properties config file")
+    parser.add_argument("port", nargs="?", type=int, default=None)
+    parser.add_argument("host", nargs="?", default=None)
+    args = parser.parse_args(argv)
+
+    props = load_properties(args.config)
+    config = cruise_control_config(props)
+    app = KafkaCruiseControlApp(config, port=args.port, host=args.host)
+    port = app.start()
+    print(f"cruise-control-tpu listening on "
+          f"http://{args.host or config.get(C.WEBSERVER_HTTP_ADDRESS_CONFIG)}:{port}"
+          f"{config.get(C.WEBSERVER_API_URLPREFIX_CONFIG)}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        app.stop()

@@ -26,6 +26,9 @@ class CruiseControlClient:
     def __init__(self, base_url: str, auth: Optional[Tuple[str, str]] = None,
                  timeout_s: float = 60.0):
         self.base = base_url.rstrip("/")
+        # The reference cccli accepts a bare host:port (-a localhost:9090).
+        if "://" not in self.base:
+            self.base = "http://" + self.base
         if not self.base.endswith("/kafkacruisecontrol"):
             self.base += "/kafkacruisecontrol"
         self._auth = auth
@@ -96,6 +99,8 @@ def build_parser() -> argparse.ArgumentParser:
         [("--goals", dict(help="comma list of goal names")),
          ("--ignore_proposal_cache", dict(action="store_true"))])
     add("kafka_cluster_state", "GET", "partition/replica state")
+    add("metrics", "GET", "sensor registry",
+        [("--format", dict(choices=["json", "prometheus"]))])
     add("user_tasks", "GET", "async task list")
     add("review_board", "GET", "two-step review board")
     add("bootstrap", "GET", "replay historical samples",
@@ -106,7 +111,8 @@ def build_parser() -> argparse.ArgumentParser:
     mut = [("--dryrun", dict(default="true", choices=["true", "false"])),
            ("--review_id", dict(type=int))]
     add("rebalance", "POST", "rebalance the cluster",
-        mut + [("--goals", dict()), ("--destination_broker_ids", dict())])
+        mut + [("--goals", dict()), ("--destination_broker_ids", dict()),
+               ("--fast_mode", dict(action="store_true"))])
     add("add_broker", "POST", "move load onto new brokers",
         mut + [("--brokerid", dict(required=True))])
     add("remove_broker", "POST", "decommission brokers",

@@ -128,9 +128,9 @@ def analyzer_config_def() -> ConfigDef:
              group="analyzer")
     d.define(MAX_OPTIMIZER_STEPS_CONFIG, Type.INT, 4096, Range.at_least(1), Importance.MEDIUM,
              doc="Upper bound on batched greedy steps per goal.", group="analyzer")
-    d.define(MOVES_PER_STEP_CONFIG, Type.INT, 64, Range.at_least(1), Importance.MEDIUM,
-             doc="Max non-conflicting moves applied per batched step (speculative batching).",
-             group="analyzer")
+    d.define(MOVES_PER_STEP_CONFIG, Type.INT, 24, Range.at_least(1), Importance.MEDIUM,
+             doc="Max actions one broker may participate in per batched step "
+                 "(selection rounds x subround lanes).", group="analyzer")
     d.define(FAST_MODE_PER_BROKER_MOVE_TIMEOUT_MS_CONFIG, Type.LONG, 500, Range.at_least(1),
              Importance.LOW, doc="Per-broker move timeout in fast mode.", group="analyzer")
     d.define(ALLOW_CAPACITY_ESTIMATION_CONFIG, Type.BOOLEAN, True, importance=Importance.MEDIUM,
@@ -173,6 +173,7 @@ SAMPLE_STORE_CLASS_CONFIG = "sample.store.class"
 METRIC_SAMPLER_CLASS_CONFIG = "metric.sampler.class"
 SKIP_LOADING_SAMPLES_CONFIG = "skip.loading.samples"
 MONITOR_STATE_UPDATE_INTERVAL_MS_CONFIG = "monitor.state.update.interval.ms"
+BOOTSTRAP_SERVERS_CONFIG = "bootstrap.servers"
 
 
 def monitor_config_def() -> ConfigDef:
@@ -198,16 +199,30 @@ def monitor_config_def() -> ConfigDef:
     d.define(MAX_ALLOWED_EXTRAPOLATIONS_PER_BROKER_CONFIG, Type.INT, 5, Range.at_least(0),
              Importance.MEDIUM, doc="Extrapolation budget per broker.", group="monitor")
     d.define(BROKER_CAPACITY_CONFIG_RESOLVER_CLASS_CONFIG, Type.STRING,
-             "cruise_control_tpu.monitor.capacity.BrokerCapacityConfigFileResolver",
-             importance=Importance.MEDIUM, doc="Capacity resolver plugin class.", group="monitor")
+             "cruise_control_tpu.monitor.capacity.StaticCapacityResolver",
+             importance=Importance.MEDIUM,
+             doc="Capacity resolver plugin class (a non-empty "
+                 "capacity.config.file selects FileCapacityResolver instead).",
+             group="monitor")
     d.define(CAPACITY_CONFIG_FILE_CONFIG, Type.STRING, "", importance=Importance.MEDIUM,
              doc="Path to the JSON broker-capacity file.", group="monitor")
     d.define(SAMPLE_STORE_CLASS_CONFIG, Type.STRING,
-             "cruise_control_tpu.monitor.sample_store.FileSampleStore",
-             importance=Importance.MEDIUM, doc="Sample store plugin class.", group="monitor")
+             "cruise_control_tpu.monitor.sampling.NoopSampleStore",
+             importance=Importance.MEDIUM,
+             doc="Sample store plugin class (with bootstrap.servers the app "
+                 "binds cruise_control_tpu.kafka.sample_store.KafkaSampleStore).",
+             group="monitor")
     d.define(METRIC_SAMPLER_CLASS_CONFIG, Type.STRING,
-             "cruise_control_tpu.monitor.sampling.InMemoryMetricSampler",
-             importance=Importance.MEDIUM, doc="Metric sampler plugin class.", group="monitor")
+             "cruise_control_tpu.monitor.sampling.SyntheticWorkloadSampler",
+             importance=Importance.MEDIUM,
+             doc="Metric sampler plugin class (with bootstrap.servers the app "
+                 "binds cruise_control_tpu.kafka.sampler.KafkaMetricSampler).",
+             group="monitor")
+    d.define(BOOTSTRAP_SERVERS_CONFIG, Type.LIST, [], importance=Importance.HIGH,
+             doc="host:port Kafka bootstrap endpoints.  Non-empty selects the "
+                 "wire-protocol production bindings (KafkaClusterAdmin, "
+                 "KafkaMetricSampler, KafkaSampleStore, metadata refresh); "
+                 "empty runs fully in-memory.", group="monitor")
     d.define(SKIP_LOADING_SAMPLES_CONFIG, Type.BOOLEAN, False, importance=Importance.LOW,
              doc="Skip replaying persisted samples on startup.", group="monitor")
     d.define(MONITOR_STATE_UPDATE_INTERVAL_MS_CONFIG, Type.LONG, 30000, Range.at_least(1),
@@ -392,7 +407,8 @@ MAX_CACHED_COMPLETED_USER_TASKS_CONFIG = "max.cached.completed.user.tasks"
 
 def webserver_config_def() -> ConfigDef:
     d = ConfigDef()
-    d.define(WEBSERVER_HTTP_PORT_CONFIG, Type.INT, 9090, Range.between(1, 65535), Importance.HIGH,
+    # 0 = OS-assigned ephemeral port (tests / parallel deployments).
+    d.define(WEBSERVER_HTTP_PORT_CONFIG, Type.INT, 9090, Range.between(0, 65535), Importance.HIGH,
              doc="HTTP port.", group="webserver")
     d.define(WEBSERVER_HTTP_ADDRESS_CONFIG, Type.STRING, "127.0.0.1", importance=Importance.HIGH,
              doc="Bind address.", group="webserver")

@@ -112,3 +112,51 @@ class SelfHealingNotifier(AnomalyNotifier):
             return AnomalyNotificationResult.check(earliest + self._heal_ms - now_ms)
         self._alert(anomaly, auto_fix=True)
         return AnomalyNotificationResult.fix()
+
+
+class AlertaSelfHealingNotifier(SelfHealingNotifier):
+    """SelfHealingNotifier that additionally posts every alert to an
+    Alerta.io endpoint (detector/notifier/AlertaSelfHealingNotifier.java:
+    POST {api_url}/alert with an Authorization: Key header; severity maps
+    from whether self-healing will fire)."""
+
+    def __init__(self, api_url: str, api_key: str = "",
+                 environment: str = "Production", origin: str = "cruise-control",
+                 http_post: Optional[Callable[[str, Dict, Dict], None]] = None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._api_url = api_url.rstrip("/")
+        self._api_key = api_key
+        self._environment = environment
+        self._origin = origin
+        self._http_post = http_post or self._default_post
+        self.post_failures = 0
+
+    @staticmethod
+    def _default_post(url: str, payload: Dict, headers: Dict) -> None:
+        import json as _json
+        import urllib.request
+        req = urllib.request.Request(
+            url, data=_json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json", **headers},
+            method="POST")
+        urllib.request.urlopen(req, timeout=10)
+
+    def _alert(self, anomaly: Anomaly, auto_fix: bool) -> None:
+        super()._alert(anomaly, auto_fix)
+        payload = {
+            "resource": anomaly.anomaly_type.name,
+            "event": type(anomaly).__name__,
+            "environment": self._environment,
+            "severity": "warning" if auto_fix else "critical",
+            "service": ["cruise-control-tpu"],
+            "origin": self._origin,
+            "text": anomaly.reason(),
+            "attributes": {"selfHealing": auto_fix,
+                           "anomalyId": anomaly.anomaly_id},
+        }
+        headers = {"Authorization": f"Key {self._api_key}"} if self._api_key else {}
+        try:
+            self._http_post(f"{self._api_url}/alert", payload, headers)
+        except Exception:  # noqa: BLE001 — alerting must never break detection
+            self.post_failures += 1

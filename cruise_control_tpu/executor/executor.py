@@ -275,6 +275,7 @@ class Executor:
             self._stop_requested = False
             self._force_stop = False
             self._reserved_for_proposals = False
+        self._sensor_started.inc()
 
         if self._on_pause:
             self._on_pause("ongoing execution")
@@ -317,7 +318,6 @@ class Executor:
 
             stopped = stopped or self._stop_requested
             buckets = tm.tasks_by_state()
-            self._sensor_started.inc()
             if stopped:
                 self._sensor_stopped.inc()
             self._sensor_completed.inc(len(buckets[TaskState.COMPLETED]))

@@ -1,0 +1,140 @@
+"""Prometheus-backed metric sampler.
+
+Parity with ``PrometheusMetricSampler``
+(monitor/sampling/prometheus/PrometheusMetricSampler.java:53 +
+PrometheusAdapter): instead of consuming the reporter topic, query a
+Prometheus server's ``/api/v1/query_range`` for the broker/topic/partition
+series (the jmx-exporter names the reference queries), convert each series
+point to a ``RawMetric``, and reuse the standard processor to derive
+partition/broker samples.
+
+Stdlib-only HTTP; the adapter takes an injectable ``http_get`` so tests run
+against a canned responder.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+import urllib.request
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from cruise_control_tpu.monitor.metadata import ClusterMetadata
+from cruise_control_tpu.monitor.metrics_processor import CruiseControlMetricsProcessor
+from cruise_control_tpu.monitor.sampling import (MetricSampler, Samples,
+                                                 SamplingMode)
+from cruise_control_tpu.reporter.raw_metrics import (MetricScope, RawMetric,
+                                                     RawMetricType)
+
+Tp = Tuple[str, int]
+
+# RawMetricType → PromQL (the reference's DEFAULT_QUERIES: jmx-exporter
+# metric names, PrometheusMetricSampler.java buildQueries).
+DEFAULT_QUERIES: Dict[RawMetricType, str] = {
+    RawMetricType.BROKER_CPU_UTIL:
+        "1 - avg by (instance) (irate(node_cpu_seconds_total{mode=\"idle\"}[1m]))",
+    RawMetricType.ALL_TOPIC_BYTES_IN:
+        "sum by (instance) (irate(kafka_server_BrokerTopicMetrics_BytesInPerSec[1m]))",
+    RawMetricType.ALL_TOPIC_BYTES_OUT:
+        "sum by (instance) (irate(kafka_server_BrokerTopicMetrics_BytesOutPerSec[1m]))",
+    RawMetricType.TOPIC_BYTES_IN:
+        "irate(kafka_server_BrokerTopicMetrics_BytesInPerSec{topic!=\"\"}[1m])",
+    RawMetricType.TOPIC_BYTES_OUT:
+        "irate(kafka_server_BrokerTopicMetrics_BytesOutPerSec{topic!=\"\"}[1m])",
+    RawMetricType.PARTITION_SIZE:
+        "kafka_log_Log_Size{topic!=\"\",partition!=\"\"}",
+}
+
+
+class PrometheusAdapter:
+    """Thin /api/v1/query_range client (prometheus/PrometheusAdapter.java)."""
+
+    def __init__(self, endpoint: str,
+                 http_get: Optional[Callable[[str], bytes]] = None,
+                 step_s: int = 60):
+        self._endpoint = endpoint.rstrip("/")
+        self._http_get = http_get or self._default_get
+        self.step_s = step_s
+
+    @staticmethod
+    def _default_get(url: str) -> bytes:
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            return resp.read()
+
+    def query_range(self, promql: str, start_s: float, end_s: float
+                    ) -> List[dict]:
+        qs = urllib.parse.urlencode({
+            "query": promql, "start": start_s, "end": end_s,
+            "step": self.step_s})
+        raw = self._http_get(f"{self._endpoint}/api/v1/query_range?{qs}")
+        doc = json.loads(raw)
+        if doc.get("status") != "success":
+            raise RuntimeError(f"prometheus query failed: {doc}")
+        return doc.get("data", {}).get("result", [])
+
+
+class PrometheusMetricSampler(MetricSampler):
+    def __init__(self, adapter: PrometheusAdapter,
+                 queries: Optional[Dict[RawMetricType, str]] = None,
+                 broker_id_of: Optional[Callable[[Dict[str, str],
+                                                  ClusterMetadata],
+                                                 Optional[int]]] = None):
+        self._adapter = adapter
+        self._queries = dict(queries or DEFAULT_QUERIES)
+        self._broker_id_of = broker_id_of or self._default_broker_id
+
+    @staticmethod
+    def _default_broker_id(labels: Dict[str, str],
+                           cluster: ClusterMetadata) -> Optional[int]:
+        """Map the series' instance label (host[:port]) onto a broker id by
+        host (the reference resolves instance host → broker likewise)."""
+        instance = labels.get("instance", "")
+        host = instance.rsplit(":", 1)[0]
+        for b in cluster.brokers:
+            if b.host == host or str(b.broker_id) == host:
+                return b.broker_id
+        return None
+
+    def get_samples(self, cluster: ClusterMetadata, partitions: Sequence[Tp],
+                    start_ms: int, end_ms: int,
+                    mode: SamplingMode = SamplingMode.ALL) -> Samples:
+        processor = CruiseControlMetricsProcessor()
+        for metric_type, promql in self._queries.items():
+            try:
+                series = self._adapter.query_range(
+                    promql, start_ms / 1000.0, end_ms / 1000.0)
+            except (OSError, RuntimeError, ValueError):
+                continue  # one failing query must not kill the whole pass
+            for entry in series:
+                labels = entry.get("metric", {})
+                broker = self._broker_id_of(labels, cluster)
+                if broker is None:
+                    continue
+                topic = labels.get("topic")
+                partition = int(labels.get("partition", -1))
+                scope = metric_type.scope
+                if scope != MetricScope.BROKER and not topic:
+                    continue
+                if scope == MetricScope.PARTITION and partition < 0:
+                    continue
+                for ts, value in entry.get("values", []):
+                    try:
+                        v = float(value)
+                    except (TypeError, ValueError):
+                        continue
+                    if metric_type == RawMetricType.BROKER_CPU_UTIL:
+                        v = min(max(v, 0.0), 1.0)
+                    processor.add_metric(RawMetric(
+                        metric_type=metric_type, time_ms=int(float(ts) * 1000),
+                        broker_id=broker, value=v,
+                        topic=topic if scope != MetricScope.BROKER else None,
+                        partition=partition if scope == MetricScope.PARTITION
+                        else -1))
+        samples = processor.process(cluster, partitions, time_ms=end_ms - 1)
+        want_partitions = mode in (SamplingMode.ALL,
+                                   SamplingMode.PARTITION_METRICS_ONLY,
+                                   SamplingMode.ONGOING_EXECUTION)
+        want_brokers = mode in (SamplingMode.ALL,
+                                SamplingMode.BROKER_METRICS_ONLY)
+        return Samples(samples.partition_samples if want_partitions else [],
+                       samples.broker_samples if want_brokers else [])

@@ -1,0 +1,166 @@
+"""gRPC analyzer sidecar: the DCN seam of the distributed design.
+
+SURVEY.md §2.10/§7: *within* an accelerator pod the batched search scales
+over ICI via GSPMD collectives (parallel/mesh.py); *between* the JVM-free
+control plane and the accelerator host, the seam is DCN — this sidecar.  A
+control plane anywhere ships a flat cluster model over gRPC and gets back
+proposals + per-goal results; the TPU stays device-resident and amortizes
+its compile caches across requests.
+
+The image carries grpcio + the protobuf runtime but not the grpc_tools
+codegen plugin, so the service is wired with grpc *generic handlers*
+around the protoc-generated messages (analyzer_service_pb2) — same wire
+format as a stub-generated service.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from concurrent import futures
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import analyzer_service_pb2 as pb  # noqa: E402  (protoc output, flat import)
+
+SERVICE = "cruise_control_tpu.AnalyzerService"
+OPTIMIZE = "Optimize"
+
+
+def model_to_proto(model) -> pb.ClusterModelProto:
+    """TensorClusterModel → wire form (valid rows only)."""
+    import jax
+    (rb, rp, rt, rl, ll, lf, cap, rack, state, rvalid, bvalid) = jax.device_get(
+        (model.replica_broker, model.replica_partition, model.replica_topic,
+         model.replica_is_leader, model.replica_load_leader,
+         model.replica_load_follower, model.broker_capacity, model.broker_rack,
+         model.broker_state, model.replica_valid, model.broker_valid))
+    r = np.asarray(rvalid)
+    b = np.asarray(bvalid)
+    return pb.ClusterModelProto(
+        replica_broker=np.asarray(rb)[r].tolist(),
+        replica_partition=np.asarray(rp)[r].tolist(),
+        replica_topic=np.asarray(rt)[r].tolist(),
+        replica_is_leader=np.asarray(rl)[r].tolist(),
+        replica_load_leader=np.asarray(ll)[r].reshape(-1).tolist(),
+        replica_load_follower=np.asarray(lf)[r].reshape(-1).tolist(),
+        broker_capacity=np.asarray(cap)[b].reshape(-1).tolist(),
+        broker_rack=np.asarray(rack)[b].tolist(),
+        broker_state=np.asarray(state)[b].astype(np.int32).tolist(),
+    )
+
+
+def proto_to_model(proto: pb.ClusterModelProto):
+    from cruise_control_tpu.model.tensor_model import build_model
+    R = len(proto.replica_broker)
+    B = len(proto.broker_rack)
+    return build_model(
+        replica_broker=np.asarray(proto.replica_broker, np.int32),
+        replica_partition=np.asarray(proto.replica_partition, np.int32),
+        replica_topic=np.asarray(proto.replica_topic, np.int32),
+        replica_is_leader=np.asarray(proto.replica_is_leader, bool),
+        replica_load_leader=np.asarray(proto.replica_load_leader,
+                                       np.float32).reshape(R, 4),
+        replica_load_follower=np.asarray(proto.replica_load_follower,
+                                         np.float32).reshape(R, 4),
+        broker_capacity=np.asarray(proto.broker_capacity,
+                                   np.float32).reshape(B, 4),
+        broker_rack=np.asarray(proto.broker_rack, np.int32),
+        broker_state=np.asarray(proto.broker_state, np.int8),
+    )
+
+
+def _optimize(request: pb.OptimizeRequest) -> pb.OptimizeResponse:
+    from cruise_control_tpu.analyzer import optimizer as opt
+    from cruise_control_tpu.analyzer import proposals as props
+    from cruise_control_tpu.analyzer.goals.specs import DEFAULT_GOAL_ORDER
+
+    try:
+        model = proto_to_model(request.model)
+        goals = list(request.goals) or list(DEFAULT_GOAL_ORDER)
+        run = opt.optimize(
+            model, goals,
+            max_steps_per_goal=request.max_steps_per_goal or 256,
+            raise_on_hard_failure=False, fused=True,
+            fast_mode=request.fast_mode)
+        diff = props.diff(model, run.model)
+    except Exception as e:  # noqa: BLE001 — errors cross the wire as payload
+        return pb.OptimizeResponse(error=f"{type(e).__name__}: {e}")
+    return pb.OptimizeResponse(
+        goal_results=[pb.GoalResultProto(
+            name=g.name, is_hard=g.is_hard,
+            satisfied_before=g.satisfied_before,
+            satisfied_after=g.satisfied_after, steps=g.steps,
+            actions_applied=g.actions_applied, capped=g.capped)
+            for g in run.goal_results],
+        proposals=[pb.ProposalProto(
+            partition=p.partition, topic=p.topic,
+            partition_size=p.partition_size, old_leader=p.old_leader.broker,
+            old_replicas=[x.broker for x in p.old_replicas],
+            new_replicas=[x.broker for x in p.new_replicas])
+            for p in diff],
+        candidates_scored=run.num_candidates_scored,
+        provision_status=run.provision_response.status.value,
+    )
+
+
+def serve_sidecar(port: int = 0, max_workers: int = 4):
+    """Start the gRPC server; returns (server, bound_port)."""
+    import grpc
+
+    handler = grpc.method_handlers_generic_handler(SERVICE, {
+        OPTIMIZE: grpc.unary_unary_rpc_method_handler(
+            lambda req, ctx: _optimize(req),
+            request_deserializer=pb.OptimizeRequest.FromString,
+            response_serializer=pb.OptimizeResponse.SerializeToString),
+    })
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((handler,))
+    bound = server.add_insecure_port(f"127.0.0.1:{port}")
+    server.start()
+    return server, bound
+
+
+class AnalyzerClient:
+    """Control-plane side: one channel, one typed method."""
+
+    def __init__(self, target: str):
+        import grpc
+        self._channel = grpc.insecure_channel(target)
+        self._optimize = self._channel.unary_unary(
+            f"/{SERVICE}/{OPTIMIZE}",
+            request_serializer=pb.OptimizeRequest.SerializeToString,
+            response_deserializer=pb.OptimizeResponse.FromString)
+
+    def optimize(self, model_proto: pb.ClusterModelProto,
+                 goals: Sequence[str] = (), fast_mode: bool = False,
+                 max_steps_per_goal: int = 0,
+                 timeout_s: float = 600.0) -> pb.OptimizeResponse:
+        return self._optimize(
+            pb.OptimizeRequest(model=model_proto, goals=list(goals),
+                               fast_mode=fast_mode,
+                               max_steps_per_goal=max_steps_per_goal),
+            timeout=timeout_s)
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    """``python -m cruise_control_tpu.parallel.sidecar [port]`` — run the
+    analyzer sidecar on the accelerator host."""
+    import time
+    port = int(argv[0]) if argv else 50051
+    server, bound = serve_sidecar(port)
+    print(f"analyzer sidecar listening on 127.0.0.1:{bound}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop(grace=5)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

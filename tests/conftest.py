@@ -16,9 +16,38 @@ import os
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+    xla_flags = (xla_flags + " --xla_force_host_platform_device_count=8").strip()
+if "xla_backend_optimization_level" not in xla_flags:
+    # Tests are XLA-compile-bound (hundreds of distinct goal-stack
+    # programs); optimization level 0 compiles ~2.7x faster with identical
+    # semantics, and cheap programs are plenty for CPU-sized test models.
+    xla_flags = (xla_flags + " --xla_backend_optimization_level=0").strip()
+os.environ["XLA_FLAGS"] = xla_flags
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# NOTE: do NOT enable jax_compilation_cache_dir here — this jaxlib build
+# segfaults in compilation_cache.put_executable_and_time when serializing
+# the large goal-stack executables (reproduced 2026-07-30).
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Free compiled executables between test modules.
+
+    With the full suite in one process, XLA's CPU backend segfaults inside
+    ``backend_compile_and_load`` after several hundred large goal-stack
+    compiles have accumulated (reproduced twice at the same spot on
+    2026-07-30; the same tests pass in isolation).  Dropping the python-side
+    executable caches between modules keeps the client's live-program count
+    bounded."""
+    yield
+    from cruise_control_tpu.analyzer import optimizer as _opt
+    _opt._step_cache.clear()
+    _opt._fixpoint_cache.clear()
+    _opt._stack_cache.clear()
+    jax.clear_caches()

@@ -1,0 +1,159 @@
+"""JWT + trusted-proxy security providers, Alerta notifier, Prometheus
+sampler (servlet/security/jwt + trustedproxy, AlertaSelfHealingNotifier,
+PrometheusMetricSampler parity)."""
+
+import json
+import time
+
+import pytest
+
+from cruise_control_tpu.api.security import (JwtSecurityProvider,
+                                             TrustedProxySecurityProvider,
+                                             encode_jwt)
+from cruise_control_tpu.api.server import ROLE_ADMIN, ROLE_USER, ROLE_VIEWER
+
+SECRET = b"test-secret"
+
+
+def _bearer(claims):
+    return {"Authorization": "Bearer " + encode_jwt(claims, SECRET)}
+
+
+def test_jwt_roles_and_signature():
+    p = JwtSecurityProvider(SECRET)
+    assert p.authenticate(_bearer({"roles": ["ADMIN"]})) == ROLE_ADMIN
+    assert p.authenticate(_bearer({"roles": ["viewer", "USER"]})) == ROLE_USER
+    assert p.authenticate(_bearer({"roles": []})) is None
+    # Wrong key → rejected.
+    bad = encode_jwt({"roles": ["ADMIN"]}, b"other-key")
+    assert p.authenticate({"Authorization": f"Bearer {bad}"}) is None
+    # Not a bearer header at all.
+    assert p.authenticate({}) is None
+    assert p.authenticate({"Authorization": "Basic abc"}) is None
+
+
+def test_jwt_expiry_and_issuer():
+    p = JwtSecurityProvider(SECRET, issuer="cc")
+    good = _bearer({"roles": ["ADMIN"], "iss": "cc",
+                    "exp": time.time() + 60})
+    assert p.authenticate(good) == ROLE_ADMIN
+    expired = _bearer({"roles": ["ADMIN"], "iss": "cc",
+                       "exp": time.time() - 60})
+    assert p.authenticate(expired) is None
+    wrong_iss = _bearer({"roles": ["ADMIN"], "iss": "other"})
+    assert p.authenticate(wrong_iss) is None
+
+
+def test_jwt_rejects_alg_none():
+    import base64
+    header = base64.urlsafe_b64encode(
+        json.dumps({"alg": "none"}).encode()).decode().rstrip("=")
+    body = base64.urlsafe_b64encode(
+        json.dumps({"roles": ["ADMIN"]}).encode()).decode().rstrip("=")
+    token = f"{header}.{body}."
+    assert JwtSecurityProvider(SECRET).authenticate(
+        {"Authorization": f"Bearer {token}"}) is None
+
+
+def test_trusted_proxy():
+    import base64
+
+    def basic(user, pw):
+        return {"Authorization": "Basic " +
+                base64.b64encode(f"{user}:{pw}".encode()).decode()}
+
+    p = TrustedProxySecurityProvider(
+        proxy_credentials={"gateway": ("pw", ROLE_ADMIN)},
+        user_roles={"alice": ROLE_ADMIN, "bob": ROLE_VIEWER})
+    hdrs = basic("gateway", "pw")
+    hdrs[TrustedProxySecurityProvider.DO_AS_HEADER] = "alice"
+    assert p.authenticate(hdrs) == ROLE_ADMIN
+    hdrs[TrustedProxySecurityProvider.DO_AS_HEADER] = "bob"
+    assert p.authenticate(hdrs) == ROLE_VIEWER
+    hdrs[TrustedProxySecurityProvider.DO_AS_HEADER] = "mallory"
+    assert p.authenticate(hdrs) is None
+    # No doAs → reject; bad proxy creds → reject.
+    assert p.authenticate(basic("gateway", "pw")) is None
+    bad = basic("gateway", "wrong")
+    bad[TrustedProxySecurityProvider.DO_AS_HEADER] = "alice"
+    assert p.authenticate(bad) is None
+
+
+def test_alerta_notifier_posts():
+    from cruise_control_tpu.detector.anomalies import GoalViolations
+    from cruise_control_tpu.detector.notifier import AlertaSelfHealingNotifier
+
+    posts = []
+    n = AlertaSelfHealingNotifier(
+        api_url="http://alerta.local/api", api_key="k123",
+        http_post=lambda url, payload, headers: posts.append(
+            (url, payload, headers)))
+    a = GoalViolations(detection_time_ms=0, fixable_goals=["DiskCapacityGoal"],
+                       unfixable_goals=[])
+    n.on_anomaly(a, now_ms=1)
+    assert len(posts) == 1
+    url, payload, headers = posts[0]
+    assert url == "http://alerta.local/api/alert"
+    assert payload["event"] == "GoalViolations"
+    assert payload["severity"] == "critical"  # self-healing disabled
+    assert headers["Authorization"] == "Key k123"
+
+    # A failing endpoint never breaks detection.
+    def boom(url, payload, headers):
+        raise OSError("down")
+    n2 = AlertaSelfHealingNotifier(api_url="http://x", http_post=boom)
+    n2.on_anomaly(a, now_ms=1)
+    assert n2.post_failures == 1
+
+
+def test_prometheus_sampler():
+    from cruise_control_tpu.monitor.metadata import (BrokerInfo,
+                                                     ClusterMetadata,
+                                                     PartitionInfo)
+    from cruise_control_tpu.monitor.prometheus import (PrometheusAdapter,
+                                                       PrometheusMetricSampler)
+    from cruise_control_tpu.reporter.raw_metrics import RawMetricType
+
+    brokers = tuple(BrokerInfo(i, rack=f"r{i}", host=f"kafka{i}")
+                    for i in range(2))
+    parts = tuple(PartitionInfo("t", p, leader=p % 2, replicas=(p % 2,))
+                  for p in range(2))
+    cluster = ClusterMetadata(brokers=brokers, partitions=parts)
+
+    def fake_get(url):
+        import urllib.parse
+        q = urllib.parse.parse_qs(urllib.parse.urlparse(url).query)["query"][0]
+        def series(metric, values):
+            return {"metric": metric, "values": values}
+        results = []
+        if "node_cpu" in q:
+            results = [series({"instance": "kafka0:9100"}, [[100, "0.4"]]),
+                       series({"instance": "kafka1:9100"}, [[100, "0.6"]])]
+        elif "BytesInPerSec" in q and "topic" in q:
+            results = [series({"instance": "kafka0:7071", "topic": "t"},
+                              [[100, "1024"]])]
+        elif "BytesInPerSec" in q:
+            results = [series({"instance": "kafka0:7071"}, [[100, "1024"]])]
+        elif "BytesOutPerSec" in q and "topic" in q:
+            results = [series({"instance": "kafka0:7071", "topic": "t"},
+                              [[100, "2048"]])]
+        elif "BytesOutPerSec" in q:
+            results = [series({"instance": "kafka0:7071"}, [[100, "2048"]])]
+        elif "Log_Size" in q:
+            results = [series({"instance": "kafka0:7071", "topic": "t",
+                               "partition": "0"}, [[100, str(1024 ** 2)]]),
+                       series({"instance": "kafka1:7071", "topic": "t",
+                               "partition": "1"}, [[100, str(2 * 1024 ** 2)]])]
+        return json.dumps({"status": "success",
+                           "data": {"result": results}}).encode()
+
+    sampler = PrometheusMetricSampler(
+        PrometheusAdapter("http://prom:9090", http_get=fake_get))
+    samples = sampler.get_samples(cluster, [p.tp for p in parts], 0, 200_000)
+    assert len(samples.broker_samples) == 2
+    cpus = {s.broker_id: s.metrics["CPU_USAGE"] for s in samples.broker_samples}
+    assert cpus == {0: pytest.approx(0.4), 1: pytest.approx(0.6)}
+    ps = {(s.topic, s.partition): s for s in samples.partition_samples}
+    assert ("t", 0) in ps and ("t", 1) in ps
+    assert ps[("t", 0)].metrics["DISK_USAGE"] == pytest.approx(1.0)
+    assert ps[("t", 0)].metrics["LEADER_BYTES_IN"] == pytest.approx(1.0)

@@ -1,0 +1,65 @@
+"""gRPC analyzer-sidecar tests (the DCN seam, SURVEY §2.10/§7 step 7):
+control plane ships a flat model over gRPC, the sidecar runs the goal stack
+and returns proposals."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("grpc")
+
+from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster
+from cruise_control_tpu.parallel import sidecar
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv, port = sidecar.serve_sidecar(port=0)
+    yield port
+    srv.stop(grace=1)
+
+
+def _model():
+    return generate_cluster(ClusterSpec(
+        num_brokers=4, num_racks=2, num_topics=3,
+        mean_partitions_per_topic=8.0, replication_factor=2,
+        distribution="exponential", seed=3))
+
+
+def test_model_proto_roundtrip():
+    model = _model()
+    proto = sidecar.model_to_proto(model)
+    back = sidecar.proto_to_model(proto)
+    assert int(back.replica_valid.sum()) == int(model.replica_valid.sum())
+    np.testing.assert_array_equal(
+        np.asarray(back.replica_broker)[np.asarray(back.replica_valid)],
+        np.asarray(model.replica_broker)[np.asarray(model.replica_valid)])
+    np.testing.assert_allclose(
+        np.asarray(back.broker_capacity)[:4],
+        np.asarray(model.broker_capacity)[:4])
+
+
+def test_sidecar_optimize_roundtrip(server):
+    client = sidecar.AnalyzerClient(f"127.0.0.1:{server}")
+    try:
+        resp = client.optimize(
+            sidecar.model_to_proto(_model()),
+            goals=["ReplicaDistributionGoal", "LeaderReplicaDistributionGoal"])
+        assert resp.error == ""
+        names = [g.name for g in resp.goal_results]
+        assert names == ["ReplicaDistributionGoal",
+                         "LeaderReplicaDistributionGoal"]
+        assert resp.candidates_scored > 0
+        for p in resp.proposals:
+            assert len(p.new_replicas) == len(p.old_replicas)
+    finally:
+        client.close()
+
+
+def test_sidecar_error_payload(server):
+    client = sidecar.AnalyzerClient(f"127.0.0.1:{server}")
+    try:
+        resp = client.optimize(sidecar.model_to_proto(_model()),
+                               goals=["NoSuchGoal"])
+        assert "NoSuchGoal" in resp.error
+    finally:
+        client.close()
