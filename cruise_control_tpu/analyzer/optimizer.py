@@ -358,8 +358,10 @@ def _goal_step(model: TensorClusterModel, options: OptimizationOptions,
     disk_guard = any(s.kind in ("intra_disk_capacity", "intra_disk_distribution")
                      for s in all_specs)
     # moves.per.step: each round keeps up to `subrounds` actions per broker,
-    # so rounds = ceil(moves_per_broker_step / subrounds).
-    subrounds = 4
+    # so rounds = ceil(moves_per_broker_step / subrounds).  Lanes are nearly
+    # free (same op count, bigger segment space); serial rounds are not —
+    # prefer wide lanes over many rounds.
+    subrounds = 8
     rounds = max(1, -(-int(constraint.moves_per_broker_step) // subrounds))
     keep = select_batched(score, cand, eligible, model, room_dest, slack_src,
                           topic_guard, disk_guard, rounds=rounds,

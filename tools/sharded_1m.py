@@ -1,0 +1,80 @@
+"""The north-star-scale sharded run: 7k brokers / ~1M replicas.
+
+Builds the full-scale model, shards its replica axis over a
+``jax.sharding.Mesh`` (parallel/mesh.py), and runs goal fixpoints through
+the sharded step — the long-axis scaling recipe (replica axis of the model
++ K axis of the candidate batch partitioned over devices; broker aggregates
+reduce via XLA-inserted collectives).
+
+Usage:
+    python tools/sharded_1m.py                 # real TPU (1-device mesh)
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/sharded_1m.py             # 8-device virtual mesh
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from cruise_control_tpu.analyzer import optimizer as opt
+    from cruise_control_tpu.analyzer.balancing_constraint import BalancingConstraint
+    from cruise_control_tpu.analyzer.goals.specs import goals_by_priority
+    from cruise_control_tpu.analyzer.state import OptimizationOptions
+    from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster
+    from cruise_control_tpu.parallel import mesh as pmesh
+
+    devs = jax.devices()
+    n = len(devs)
+    t0 = time.monotonic()
+    # 7k brokers, ~1M replicas (the reference's production scale,
+    # README.md:8 + the 800k-replica stress anchor, Resource.java:28-31).
+    spec = ClusterSpec(num_brokers=7000, num_racks=70, num_topics=200,
+                       mean_partitions_per_topic=1667.0, replication_factor=3,
+                       distribution="exponential", seed=2026)
+    model = generate_cluster(spec, pad_replicas_to_multiple=n)
+    build_s = time.monotonic() - t0
+    num_replicas = int(np.asarray(model.replica_valid).sum())
+    print(f"model built: B=7000 R={num_replicas} ({build_s:.1f}s), "
+          f"mesh={n} device(s)", flush=True)
+
+    mesh = Mesh(np.array(devs), (pmesh.SEARCH_AXIS,))
+    model = pmesh.shard_model_replica_axis(model, mesh)
+    jax.block_until_ready(model.replica_broker)
+    options = OptimizationOptions.none(model)
+    constraint = BalancingConstraint.default()
+
+    goals = ["RackAwareGoal", "ReplicaDistributionGoal"]
+    results = {}
+    prev = ()
+    for name in goals:
+        gspec = goals_by_priority([name])[0]
+        step = pmesh.make_sharded_step(gspec, prev, constraint, 2048, 64, mesh)
+        t0 = time.monotonic()
+        new_model, n_applied = step(model, options)
+        jax.block_until_ready(new_model.replica_broker)
+        compile_run_s = time.monotonic() - t0
+        t0 = time.monotonic()
+        new_model, n_applied = step(model, options)
+        jax.block_until_ready(new_model.replica_broker)
+        step_s = time.monotonic() - t0
+        model = new_model
+        prev = prev + (gspec,)
+        results[name] = {"applied": int(n_applied),
+                         "compile_s": round(compile_run_s, 2),
+                         "step_s": round(step_s, 3)}
+        print(f"{name}: {results[name]}", flush=True)
+
+    print(json.dumps({"metric": "sharded_1m_step", "num_replicas": num_replicas,
+                      "num_brokers": 7000, "devices": n, "per_goal": results}))
+
+
+if __name__ == "__main__":
+    main()
