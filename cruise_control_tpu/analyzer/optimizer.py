@@ -631,14 +631,46 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
         if fuse_group_size is None and model.num_brokers >= 100:
             fuse_group_size = 1
         group = fuse_group_size or len(specs) or 1
+        # At ≥500-broker shapes a single goal's full fixpoint can run many
+        # minutes inside ONE dispatch, and the tunneled TPU worker kills
+        # long executions ("TPU worker process crashed").  Segment each
+        # goal's fixpoint into bounded dispatches and continue while the
+        # segment reports capped — identical math (the model state carries
+        # over), a few extra host syncs.
+        segment_steps = 32 if (group == 1 and model.num_brokers >= 500) else None
         packed_rows = []
         prev: Tuple[GoalSpec, ...] = ()
         for start in range(0, len(specs), group):
             chunk = tuple(specs[start:start + group])
-            stack_fn = _get_stack_fn(chunk, constraint, ns, nd,
-                                     max_steps_per_goal, prev_specs=prev)
-            model, packed = stack_fn(model, options)
-            packed_rows.append(packed)
+            if segment_steps is not None:
+                steps_t = actions_t = 0
+                before0 = None
+                after_f = 0
+                capped_f = 0
+                remaining = max(max_steps_per_goal, 1)
+                while remaining > 0:
+                    seg = min(segment_steps, remaining)
+                    stack_fn = _get_stack_fn(chunk, constraint, ns, nd, seg,
+                                             prev_specs=prev)
+                    model, packed = stack_fn(model, options)
+                    row = jax.device_get(packed)[:, 0]
+                    steps_t += int(row[0])
+                    actions_t += int(row[1])
+                    if before0 is None:
+                        before0 = int(row[2])
+                    after_f = int(row[3])
+                    capped_f = int(row[4])  # 0 exactly when a true fixpoint
+                    remaining -= seg
+                    if not capped_f:
+                        break
+                packed_rows.append(np.array(
+                    [[steps_t], [actions_t], [before0], [after_f], [capped_f]],
+                    np.int64))
+            else:
+                stack_fn = _get_stack_fn(chunk, constraint, ns, nd,
+                                         max_steps_per_goal, prev_specs=prev)
+                model, packed = stack_fn(model, options)
+                packed_rows.append(packed)
             prev = prev + chunk
         fetched = jax.device_get(tuple(packed_rows))
         steps_v, actions_v, before_v, after_v, capped_v = (
