@@ -559,7 +559,8 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
              fused: bool = False,
              fuse_group_size: Optional[int] = None,
              fast_mode: bool = False,
-             max_candidates_per_step: Optional[int] = None) -> OptimizerRun:
+             max_candidates_per_step: Optional[int] = None,
+             segment_steps: Optional[int] = None) -> OptimizerRun:
     """Run the goal stack in priority order (GoalOptimizer.optimizations).
 
     Each goal optimizes the model to its fixpoint, constrained by the
@@ -637,7 +638,8 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
         # goal's fixpoint into bounded dispatches and continue while the
         # segment reports capped — identical math (the model state carries
         # over), a few extra host syncs.
-        segment_steps = 32 if (group == 1 and model.num_brokers >= 500) else None
+        if segment_steps is None and group == 1 and model.num_brokers >= 500:
+            segment_steps = 32
         packed_rows = []
         prev: Tuple[GoalSpec, ...] = ()
         for start in range(0, len(specs), group):

@@ -143,3 +143,24 @@ def test_requested_destination_brokers():
     moved = np.asarray(run.model.replica_broker) != initial_rb
     if moved.any():
         assert (np.asarray(run.model.replica_broker)[moved] == 3).all()
+
+
+def test_segmented_fixpoint_matches_unsegmented():
+    """The xl-scale segmented execution (bounded per-dispatch step budgets,
+    re-entered while capped) must produce the same optimization as one
+    unsegmented fixpoint — the model state carries across segments."""
+    spec = ClusterSpec(num_brokers=6, num_racks=3, num_topics=4,
+                       mean_partitions_per_topic=10.0, seed=11)
+    model = generate_cluster(spec)
+    stack = ["RackAwareGoal", "ReplicaDistributionGoal"]
+    whole = opt.optimize(model, stack, raise_on_hard_failure=False,
+                         fused=True, fuse_group_size=1)
+    segmented = opt.optimize(model, stack, raise_on_hard_failure=False,
+                             fused=True, fuse_group_size=1, segment_steps=2)
+    for a, b in zip(whole.goal_results, segmented.goal_results):
+        assert a.satisfied_after == b.satisfied_after
+        assert a.actions_applied == b.actions_applied, (a, b)
+        assert a.steps == b.steps
+    rb_a = np.asarray(whole.model.replica_broker)
+    rb_b = np.asarray(segmented.model.replica_broker)
+    np.testing.assert_array_equal(rb_a, rb_b)
