@@ -20,7 +20,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
+    # The image's sitecustomize force-registers the remote TPU plugin and
+    # overrides jax_platforms; honor an explicit JAX_PLATFORMS=cpu request
+    # by resetting the CONFIG before backend init (see tests/conftest.py).
     import jax
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        jax.config.update("jax_platforms", "cpu")
     import numpy as np
     from jax.sharding import Mesh
 
