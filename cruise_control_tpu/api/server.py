@@ -439,11 +439,53 @@ class PlainText(str):
     """Marker: endpoint result is preformatted text, not JSON."""
 
 
+class HtmlText(str):
+    """Marker: endpoint result is an HTML page."""
+
+
+# Minimal status UI (the reference bundles the separate cruise-control-ui
+# webapp behind the same Jetty server, KafkaCruiseControlApp.java:100-195;
+# this build ships a single self-contained page driven by the JSON API).
+_INDEX_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>cruise-control-tpu</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:2rem;max-width:72rem}
+ h1{font-size:1.3rem} h2{font-size:1.05rem;margin-top:1.5rem}
+ pre{background:#f6f8fa;padding:0.8rem;border-radius:6px;overflow:auto}
+ a{color:#0969da;text-decoration:none} .row a{margin-right:1rem}
+</style></head>
+<body>
+<h1>cruise-control-tpu</h1>
+<div class="row">
+ <a href="%PREFIX%/state">state</a>
+ <a href="%PREFIX%/kafka_cluster_state">kafka_cluster_state</a>
+ <a href="%PREFIX%/proposals">proposals</a>
+ <a href="%PREFIX%/metrics">metrics</a>
+ <a href="%PREFIX%/user_tasks">user_tasks</a>
+</div>
+<h2>State</h2><pre id="state">loading…</pre>
+<h2>Sensors</h2><pre id="sensors">loading…</pre>
+<script>
+ fetch("%PREFIX%/state").then(r=>r.json()).then(s=>{
+   document.getElementById("sensors").textContent =
+     JSON.stringify(s.Sensors ?? {}, null, 2);
+   delete s.Sensors;
+   document.getElementById("state").textContent = JSON.stringify(s, null, 2);
+ }).catch(e=>{document.getElementById("state").textContent = String(e)});
+</script>
+</body></html>
+"""
+
+
 class _Handler(BaseHTTPRequestHandler):
     api: CruiseControlApi = None  # injected by serve()
 
     def _dispatch(self, method: str) -> None:
         parsed = urllib.parse.urlparse(self.path)
+        if method == "GET" and parsed.path.rstrip("/") in ("", PREFIX):
+            self._reply(200, HtmlText(_INDEX_HTML.replace("%PREFIX%", PREFIX)),
+                        {})
+            return
         if not parsed.path.startswith(PREFIX + "/"):
             self._reply(404, {"error": f"paths live under {PREFIX}/"}, {})
             return
@@ -455,7 +497,10 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(status, body, headers)
 
     def _reply(self, status: int, body: Dict, headers: Dict[str, str]) -> None:
-        if isinstance(body, PlainText):
+        if isinstance(body, HtmlText):
+            payload = str(body).encode()
+            ctype = "text/html; charset=utf-8"
+        elif isinstance(body, PlainText):
             payload = str(body).encode()
             ctype = "text/plain; version=0.0.4"
         else:

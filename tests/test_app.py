@@ -102,3 +102,23 @@ def test_cccli_against_app(tmp_path, capsys):
         assert "MonitorState" in out or "running" in out
     finally:
         app.stop()
+
+
+def test_index_page(tmp_path):
+    """GET / serves the bundled status UI (the reference serves the
+    cruise-control-ui webapp from the same server)."""
+    props = tmp_path / "cc.properties"
+    props.write_text("webserver.http.port=0\n")
+    config = cruise_control_config(load_properties(str(props)))
+    app = KafkaCruiseControlApp(config)
+    port = app.start()
+    try:
+        resp = urllib.request.urlopen(f"http://127.0.0.1:{port}/")
+        assert resp.headers["Content-Type"].startswith("text/html")
+        html = resp.read().decode()
+        assert "cruise-control-tpu" in html and "/kafkacruisecontrol/state" in html
+        resp2 = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/kafkacruisecontrol")
+        assert resp2.headers["Content-Type"].startswith("text/html")
+    finally:
+        app.stop()
