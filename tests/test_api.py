@@ -288,3 +288,29 @@ def test_train_endpoint():
     api, _, _ = build_stack()
     s, body, _ = api.handle("GET", "train", {})
     assert s == 200 and body["trained"]
+
+
+def test_add_broker_moves_load_onto_new_broker():
+    """ADD_BROKER (AddBrokersRunnable / RandomClusterUniformDistNewBrokerTest
+    analogue): a broker added to metadata with no replicas receives load."""
+    api, cc, mc = build_stack(num_brokers=5)
+    cluster = mc.cluster()
+    new_id = 99
+    brokers = cluster.brokers + (BrokerInfo(new_id, rack="r9", host="h9"),)
+    mc.refresh(dataclasses.replace(cluster, brokers=brokers))
+    # Refresh samples so the new metadata generation has windows.
+    lm = cc.load_monitor
+    sampler = SyntheticWorkloadSampler()
+    for wdx in range(4):
+        lm.fetch_once(sampler, wdx * W, wdx * W + 1)
+
+    s, body, _ = api.handle("POST", "add_broker",
+                            {"brokerid": str(new_id), "dryrun": "false",
+                             "max_wait_s": "120"})
+    assert s == 200, body
+    # The new broker now hosts replicas in the refreshed metadata.
+    counts = {b: 0 for b in [br.broker_id for br in mc.cluster().brokers]}
+    for p in mc.cluster().partitions:
+        for b in p.replicas:
+            counts[b] += 1
+    assert counts[new_id] > 0, counts
