@@ -46,6 +46,10 @@ def main() -> None:
     # programs hang the tunneled remote-compile service; a bounded batch
     # compiles reliably and the lanes make up the throughput.
     max_candidates = int(os.environ.get("BENCH_MAX_CANDIDATES", "0")) or None
+    # BENCH_FAST=1 runs the stack in fast_mode (narrower batches, quartered
+    # step budget) — the xl rung's full fixpoints are hours of single-chip
+    # device time; a labeled fast-mode record beats no record.
+    fast = bool(int(os.environ.get("BENCH_FAST", "0")))
     brokers, racks, topics, ppt, rf = SCALES[scale]
 
     from cruise_control_tpu.analyzer import optimizer as opt
@@ -69,11 +73,11 @@ def main() -> None:
     # one-program 15-goal compile kernel-faults the TPU worker at 200-broker
     # shapes — chunks of 5 compile and run fine).
     opt.optimize(model, STACK, raise_on_hard_failure=False, fused=True,
-                 max_candidates_per_step=max_candidates)
+                 max_candidates_per_step=max_candidates, fast_mode=fast)
 
     t0 = time.monotonic()
     run = opt.optimize(model, STACK, raise_on_hard_failure=False, fused=True,
-                       max_candidates_per_step=max_candidates)
+                       max_candidates_per_step=max_candidates, fast_mode=fast)
     proposals = props.diff(model, run.model)
     wall_s = time.monotonic() - t0
 
@@ -92,6 +96,7 @@ def main() -> None:
         "num_proposals": len(proposals),
         "hard_goals_satisfied": hard_ok,
         "candidates_scored": run.num_candidates_scored,
+        **({"fast_mode": True} if fast else {}),
     }))
 
 

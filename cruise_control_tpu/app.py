@@ -152,6 +152,12 @@ class KafkaCruiseControlApp:
             BrokerFailureDetector(self.metadata_client), interval)
         self.detector_manager.register_detector(
             DiskFailureDetector(self.admin, self.metadata_client), interval)
+        if self._kafka_client is not None:
+            from cruise_control_tpu.detector.detectors import MaintenanceEventDetector
+            from cruise_control_tpu.kafka.maintenance import KafkaMaintenanceEventReader
+            self.detector_manager.register_detector(
+                MaintenanceEventDetector(
+                    KafkaMaintenanceEventReader(self._kafka_client)), interval)
 
         security: SecurityProvider = SecurityProvider()
         if cfg.get(C.WEBSERVER_SECURITY_ENABLE_CONFIG):

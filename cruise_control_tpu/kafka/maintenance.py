@@ -1,0 +1,126 @@
+"""Maintenance plans over a Kafka topic.
+
+Parity with ``MaintenanceEventTopicReader`` + ``MaintenancePlanSerde``
+(detector/MaintenanceEventTopicReader.java:25, MaintenancePlan.java,
+MaintenancePlanSerde.java): operators publish versioned plans to a
+maintenance topic; the detector side consumes them offset-tracked and feeds
+``MaintenanceEventDetector`` (whose idempotence cache dedups retried
+publishes).  Plans ride as JSON record values with an explicit version
+field — unknown versions and malformed records are skipped, not fatal.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from cruise_control_tpu.detector.anomalies import (MaintenanceEvent,
+                                                   MaintenancePlanType)
+from cruise_control_tpu.kafka.client import KafkaClient, KafkaError
+from cruise_control_tpu.kafka.protocol import Record
+
+MAINTENANCE_TOPIC = "__CruiseControlMaintenance"
+PLAN_VERSION = 0
+
+
+def encode_plan(event: MaintenanceEvent) -> bytes:
+    return json.dumps({
+        "version": PLAN_VERSION,
+        "planType": event.plan_type.value,
+        "timeMs": event.detection_time_ms,
+        "brokers": list(event.brokers),
+        "topicsRf": dict(event.topics_rf),
+    }).encode()
+
+
+def decode_plan(value: bytes) -> Optional[MaintenanceEvent]:
+    try:
+        d = json.loads(value.decode())
+        if d.get("version") != PLAN_VERSION:
+            return None
+        return MaintenanceEvent(
+            detection_time_ms=int(d.get("timeMs", 0)),
+            plan_type=MaintenancePlanType(d["planType"]),
+            brokers=tuple(int(b) for b in d.get("brokers", ())),
+            topics_rf={str(k): int(v)
+                       for k, v in d.get("topicsRf", {}).items()})
+    except (ValueError, KeyError, UnicodeDecodeError, TypeError):
+        return None  # malformed/foreign record: skip, keep consuming
+
+
+class KafkaMaintenancePublisher:
+    """Operator side: publish a plan to the maintenance topic."""
+
+    def __init__(self, client: KafkaClient, topic: str = MAINTENANCE_TOPIC):
+        self._client = client
+        self._topic = topic
+        self._ensured = False
+
+    def _ensure_topic(self) -> None:
+        if not self._ensured:
+            errors = self._client.create_topics(
+                {self._topic: (1, 1)},
+                configs={self._topic: {"retention.ms": "86400000",
+                                       "compression.type": "none"}})
+            code = errors.get(self._topic, 0)
+            if code not in (0, 36):
+                raise KafkaError(code, f"creating {self._topic}")
+            self._ensured = True
+
+    def publish(self, event: MaintenanceEvent) -> None:
+        self._ensure_topic()
+        self._client.produce((self._topic, 0),
+                             [Record(key=None, value=encode_plan(event))])
+
+
+class KafkaMaintenanceEventReader:
+    """Detector side: drop-in for ``MaintenanceEventReader`` — ``drain()``
+    returns plans published since the last poll (offset-tracked consume,
+    MaintenanceEventTopicReader's assign-and-seek loop)."""
+
+    def __init__(self, client: KafkaClient, topic: str = MAINTENANCE_TOPIC):
+        self._client = client
+        self._topic = topic
+        self._offsets: Dict[int, int] = {}
+        self._first_poll = True
+
+    def drain(self) -> List[MaintenanceEvent]:
+        out: List[MaintenanceEvent] = []
+        try:
+            md = self._client.metadata([self._topic])
+            partitions = sorted(p.partition for p in md.partitions
+                                if p.topic == self._topic)
+        except (KafkaError, ConnectionError, OSError):
+            return out
+        first_poll, self._first_poll = self._first_poll, False
+        for mp in partitions:
+            offset = self._offsets.get(mp)
+            if offset is None:
+                # Partitions present at the FIRST poll start at the log end
+                # (plans published before this service instance are not
+                # replayed — the reference seeks past the last-checked time
+                # likewise); a topic/partition appearing later was created
+                # after the reader started, so everything in it is new.
+                try:
+                    offset = self._client.list_offset(
+                        (self._topic, mp), -1 if first_poll else -2)
+                except (KafkaError, ConnectionError, OSError):
+                    continue
+            while True:
+                try:
+                    records, hwm = self._client.fetch((self._topic, mp), offset)
+                except (KafkaError, ConnectionError, OSError, ValueError):
+                    break
+                if not records:
+                    break
+                for rec in records:
+                    offset = max(offset, rec.offset + 1)
+                    if rec.value is None:
+                        continue
+                    event = decode_plan(rec.value)
+                    if event is not None:
+                        out.append(event)
+                if offset >= hwm:
+                    break
+            self._offsets[mp] = offset
+        return out

@@ -459,3 +459,48 @@ def test_executor_refuses_foreign_reassignment_wire(client, broker):
     with pytest.raises(OngoingExecutionError):
         executor.execute_proposals(
             [_make_proposal(0, 1.0, old=(0,), new=(1,))], [("f", 0)])
+
+
+# ---------------------------------------------------------------------------
+# Maintenance plans over the wire (MaintenanceEventTopicReader translation)
+# ---------------------------------------------------------------------------
+
+def test_maintenance_plans_over_topic(client, broker):
+    from cruise_control_tpu.detector.anomalies import (MaintenanceEvent,
+                                                       MaintenancePlanType)
+    from cruise_control_tpu.detector.detectors import MaintenanceEventDetector
+    from cruise_control_tpu.kafka.maintenance import (
+        KafkaMaintenanceEventReader, KafkaMaintenancePublisher, decode_plan,
+        encode_plan)
+
+    # serde round trip + versioning
+    ev = MaintenanceEvent(detection_time_ms=5,
+                          plan_type=MaintenancePlanType.REMOVE_BROKER,
+                          brokers=(1, 2))
+    back = decode_plan(encode_plan(ev))
+    assert back.plan_type == ev.plan_type and back.brokers == (1, 2)
+    assert decode_plan(b"not json") is None
+    assert decode_plan(b'{"version": 99, "planType": "rebalance"}') is None
+
+    reader = KafkaMaintenanceEventReader(client)
+    publisher = KafkaMaintenancePublisher(client)
+    # Reader initialized BEFORE any publish: starts at log end.
+    assert reader.drain() == []
+
+    publisher.publish(ev)
+    publisher.publish(MaintenanceEvent(
+        detection_time_ms=6, plan_type=MaintenancePlanType.TOPIC_REPLICATION_FACTOR,
+        topics_rf={"t": 3}))
+    plans = reader.drain()
+    assert [p.plan_type for p in plans] == [
+        MaintenancePlanType.REMOVE_BROKER,
+        MaintenancePlanType.TOPIC_REPLICATION_FACTOR]
+    assert plans[1].topics_rf == {"t": 3}
+    assert reader.drain() == []  # offsets advanced
+
+    # The detector's idempotence cache dedups a retried publish.
+    detector = MaintenanceEventDetector(reader)
+    publisher.publish(ev)
+    publisher.publish(ev)  # operator retry
+    events = detector.detect(now_ms=100)
+    assert len(events) == 1 and events[0].plan_type == MaintenancePlanType.REMOVE_BROKER
