@@ -55,6 +55,12 @@ for _n in range(256):
 
 
 def crc32c(data: bytes, crc: int = 0) -> int:
+    # Native slicing-by-8 fast path (~1 GB/s vs ~1 MB/s for the Python
+    # loop) — record batches are checksummed on every produce and fetch.
+    from cruise_control_tpu import native
+    fast = native.crc32c(data, crc)
+    if fast is not None:
+        return fast
     crc ^= 0xFFFFFFFF
     for b in data:
         crc = _CRC32C_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)

@@ -68,6 +68,9 @@ def _load() -> Optional[ctypes.CDLL]:
                 _f64p, _f64p, _f64p, _i64p, _i64p,
                 ctypes.c_int64, ctypes.c_int64,
                 _i64p, _i64p, _i64p, _f64p, _u8p, ctypes.c_int64]
+            lib.crc32c_update.restype = ctypes.c_uint32
+            lib.crc32c_update.argtypes = [
+                ctypes.c_uint32, ctypes.c_char_p, ctypes.c_int64]
             _LIB = lib
         except (OSError, subprocess.CalledProcessError):
             _LIB = None
@@ -140,3 +143,12 @@ def ingest_samples(sum_arr, max_arr, latest_arr, latest_ts, count,
         np.ascontiguousarray(value_mask, np.uint8),
         int(rows.shape[0]))
     return True
+
+
+def crc32c(data: bytes, crc: int = 0) -> Optional[int]:
+    """CRC-32C via the native slicing-by-8 kernel; None when unavailable
+    (callers fall back to the Python table loop)."""
+    lib = _load()
+    if lib is None:
+        return None
+    return int(lib.crc32c_update(crc, data, len(data)))
