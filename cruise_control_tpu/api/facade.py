@@ -168,8 +168,12 @@ class CruiseControl:
         execution = None
         ok = True
         if not dryrun and proposals:
+            # Live broker health feeds the ConcurrencyAdjuster during the
+            # wait loop (Executor.java:335-447 reads request-queue depth /
+            # handler idle ratio each interval).
             execution = self.executor.execute_proposals(
-                proposals, naming["partitions"])
+                proposals, naming["partitions"],
+                concurrency_adjust_metrics=self.load_monitor.broker_health_metrics)
             ok = execution.ok
         return OperationResult(
             ok=ok, dryrun=dryrun, proposals=proposals,

@@ -195,6 +195,23 @@ class LoadMonitor:
             self._store.store_samples(samples)
         return n
 
+    def broker_health_metrics(self) -> Dict[int, Dict[str, float]]:
+        """{broker → {metric name → latest collapsed value}} for the
+        executor's ConcurrencyAdjuster (Executor.java:335-447 reads live
+        request-queue depth / handler idle ratio from the broker metric
+        history)."""
+        agg = self.broker_aggregator.aggregate()
+        out: Dict[int, Dict[str, float]] = {}
+        names = [KAFKA_METRIC_DEF.metric_info_by_id(m).name
+                 for m in range(agg.collapsed.shape[1])]
+        for row, broker_id in enumerate(agg.entities):
+            if not agg.entity_valid[row]:
+                continue
+            out[int(broker_id)] = {
+                name: float(agg.collapsed[row, m])
+                for m, name in enumerate(names)}
+        return out
+
     # -- completeness ------------------------------------------------------
     def monitored_partitions_percentage(self) -> float:
         # Generation-cached: this is a sensor read on the /state and

@@ -243,3 +243,25 @@ def test_file_capacity_resolver():
     assert b9.is_estimated and b9.disk == 500000.0
     with pytest.raises(ValueError):
         r.capacity_for_broker("r0", "h9", 9, allow_estimation=False)
+
+
+def test_broker_health_metrics_feed():
+    """LoadMonitor.broker_health_metrics supplies the executor's
+    ConcurrencyAdjuster with the latest collapsed broker values
+    (Executor.java:335-447's live health read)."""
+    from cruise_control_tpu.executor.executor import ConcurrencyAdjuster
+    from cruise_control_tpu.executor.task_manager import ConcurrencyLimits
+
+    lm = sampled_monitor()
+    health = lm.broker_health_metrics()
+    assert set(health) == set(lm._metadata.cluster().alive_broker_ids())
+    sample = next(iter(health.values()))
+    assert "BROKER_REQUEST_QUEUE_SIZE" in sample
+    assert "BROKER_REQUEST_HANDLER_POOL_IDLE_PERCENT" in sample
+
+    # Healthy metrics → the adjuster re-expands toward the base limit.
+    base = ConcurrencyLimits(inter_broker_per_broker=8)
+    adj = ConcurrencyAdjuster(base)
+    limits = ConcurrencyLimits(inter_broker_per_broker=2)
+    grown = adj.adjust(limits, health)
+    assert grown.inter_broker_per_broker == 4
