@@ -120,8 +120,14 @@ class KafkaCruiseControlApp:
             max_allowed_extrapolations=cfg.get(
                 C.MAX_ALLOWED_EXTRAPOLATIONS_PER_PARTITION_CONFIG))
         throttle_rate = cfg.get(C.DEFAULT_REPLICATION_THROTTLE_CONFIG)
+        # The executor's wait loop must observe reassignment completion:
+        # with Kafka bindings it reads a refreshing view (every poll hits
+        # the wire), not the TTL-stale shared snapshot.
+        executor_metadata = (self._refresher.executor_view()
+                             if self._refresher is not None
+                             else self.metadata_client)
         self.executor = Executor(
-            self.admin, self.metadata_client,
+            self.admin, executor_metadata,
             throttle_rate_bytes_per_sec=(
                 throttle_rate if throttle_rate and throttle_rate > 0 else None),
             on_sampling_pause=self.load_monitor.pause_sampling,

@@ -62,6 +62,13 @@ class KafkaMetadataRefresher:
         self._last = 0.0
         self._lock = threading.Lock()
 
+    def executor_view(self) -> "RefreshingMetadataView":
+        """Metadata view for the Executor's wait loop: every ``cluster()``
+        read re-polls the wire, so reassignment completion is observed
+        (the reference's executor polls live metadata each interval,
+        Executor.java:1431; a TTL-stale snapshot would spin forever)."""
+        return RefreshingMetadataView(self)
+
     def maybe_refresh(self, force: bool = False) -> ClusterMetadata:
         with self._lock:
             now = time.monotonic()
@@ -77,3 +84,15 @@ class KafkaMetadataRefresher:
                         dataclasses.replace(cur, generation=0):
                     return self._md.refresh(fresh)
             return self._md.cluster()
+
+
+class RefreshingMetadataView:
+    """Executor-facing adapter: ``cluster()`` forces a wire refresh through
+    the shared refresher, so the shared MetadataClient snapshot (and its
+    generation gating) advances for every other consumer too."""
+
+    def __init__(self, refresher: KafkaMetadataRefresher):
+        self._refresher = refresher
+
+    def cluster(self) -> ClusterMetadata:
+        return self._refresher.maybe_refresh(force=True)

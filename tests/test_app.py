@@ -122,3 +122,70 @@ def test_index_page(tmp_path):
         assert resp2.headers["Content-Type"].startswith("text/html")
     finally:
         app.stop()
+
+
+def test_rest_rebalance_executes_over_wire(tmp_path):
+    """The full production path in one flow: service boots from config
+    against the (fake) Kafka cluster, ingests reporter metrics over the
+    wire, and a REST POST /rebalance?dryrun=false runs the optimizer and
+    EXECUTES the proposals — real AlterPartitionReassignments + elections
+    against the broker, with throttles set and cleaned."""
+    import time
+
+    fb = FakeKafkaBroker(num_brokers=4).start()
+    # Heavily skewed assignment: brokers 0/1 hold everything.
+    assignment = {p: [p % 2, (p + 1) % 2] for p in range(12)}
+    fb.create_topic("payload", partitions=12, rf=2, assignment=assignment)
+    try:
+        client = KafkaClient([(fb.host, fb.port)], timeout_s=5.0)
+        leaders = {(t, p): part.leader for t, parts in fb.topics.items()
+                   for p, part in parts.items()}
+        source = SyntheticBrokerMetricsSource({"payload": 12}, leaders)
+
+        props = tmp_path / "cc.properties"
+        props.write_text(f"bootstrap.servers={fb.host}:{fb.port}\n"
+                         "webserver.http.port=0\n"
+                         "num.partition.metrics.windows=2\n"
+                         "metric.sampling.interval.ms=100000\n")
+        config = cruise_control_config(load_properties(str(props)))
+        app = KafkaCruiseControlApp(config)
+        port = app.start()
+        try:
+            W = 300_000
+            for w in range(3):
+                for b in fb.broker_ids:
+                    MetricsReporterAgent(client, source, broker_id=b
+                                         ).report_once(time_ms=w * W + 10)
+                app.load_monitor.fetch_once(app.sampler, w * W, w * W + 20)
+
+            base = f"http://127.0.0.1:{port}/kafkacruisecontrol"
+            task = None
+            body = None
+            for _ in range(600):
+                req = urllib.request.Request(
+                    f"{base}/rebalance?dryrun=false&"
+                    "goals=ReplicaDistributionGoal,LeaderReplicaDistributionGoal",
+                    method="POST")
+                if task:
+                    req.add_header("User-Task-ID", task)
+                resp = urllib.request.urlopen(req)
+                body = json.load(resp)
+                if resp.status == 200:
+                    break
+                task = resp.headers.get("User-Task-ID")
+                time.sleep(0.05)
+            assert body and body.get("ok"), body
+            assert body["execution"]["completed"] > 0, body["execution"]
+
+            # The fake broker's real replica placement changed: brokers 2/3
+            # now host replicas.
+            counts = {b: 0 for b in fb.broker_ids}
+            for part in fb.topics["payload"].values():
+                for b in part.replicas:
+                    counts[b] += 1
+            assert counts[2] > 0 and counts[3] > 0, counts
+        finally:
+            app.stop()
+        client.close()
+    finally:
+        fb.stop()
