@@ -126,12 +126,17 @@ class KafkaCruiseControlApp:
         executor_metadata = (self._refresher.executor_view()
                              if self._refresher is not None
                              else self.metadata_client)
+        from cruise_control_tpu.executor.min_isr import (TopicMinIsrCache,
+                                                         min_isr_pressure)
+        isr_cache = TopicMinIsrCache(self.admin)
         self.executor = Executor(
             self.admin, executor_metadata,
             throttle_rate_bytes_per_sec=(
                 throttle_rate if throttle_rate and throttle_rate > 0 else None),
             on_sampling_pause=self.load_monitor.pause_sampling,
-            on_sampling_resume=self.load_monitor.resume_sampling)
+            on_sampling_resume=self.load_monitor.resume_sampling,
+            min_isr_pressure_fn=lambda: min_isr_pressure(
+                executor_metadata.cluster(), isr_cache))
         from cruise_control_tpu.analyzer.balancing_constraint import BalancingConstraint
         self.cruise_control = CruiseControl(
             self.load_monitor, self.executor, self.admin,

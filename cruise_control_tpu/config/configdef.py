@@ -315,7 +315,17 @@ class Config:
 
 
 def load_properties(path: str) -> Dict[str, str]:
-    """Parse a java-style .properties file (comments, key=value)."""
+    """Parse a java-style .properties file (comments, key=value), with
+    ``${env:VAR}`` substitution in values (EnvConfigProvider semantics —
+    the reference resolves env indirections when loading config; unset
+    variables substitute to empty)."""
+    import os
+    import re
+
+    def substitute(value: str) -> str:
+        return re.sub(r"\$\{env:([A-Za-z_][A-Za-z0-9_]*)\}",
+                      lambda m: os.environ.get(m.group(1), ""), value)
+
     props: Dict[str, str] = {}
     with open(path, "r", encoding="utf-8") as fh:
         for line in fh:
@@ -328,5 +338,5 @@ def load_properties(path: str) -> Dict[str, str]:
                 key, _, value = line.partition(":")
             else:
                 continue
-            props[key.strip()] = value.strip()
+            props[key.strip()] = substitute(value.strip())
     return props

@@ -84,6 +84,9 @@ class KafkaSampleStore(SampleStore):
         md = self._client.metadata([topic])
         return sorted(p.partition for p in md.partitions if p.topic == topic)
 
+    def read_only(self) -> "ReadOnlyKafkaSampleStore":
+        return ReadOnlyKafkaSampleStore(self)
+
     @staticmethod
     def _decode_into(out: Samples, value) -> None:
         if not value:
@@ -104,3 +107,18 @@ class KafkaSampleStore(SampleStore):
                     metrics=d["metrics"]))
         except KeyError:
             return
+
+
+class ReadOnlyKafkaSampleStore(SampleStore):
+    """Warm-start replay without writes (sampling/ReadOnlyKafkaSampleStore):
+    lets a canary/staging instance bootstrap its windows from production
+    sample topics without polluting them."""
+
+    def __init__(self, delegate: KafkaSampleStore):
+        self._delegate = delegate
+
+    def store_samples(self, samples: Samples) -> None:
+        pass
+
+    def load_samples(self) -> Samples:
+        return self._delegate.load_samples()

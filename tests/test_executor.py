@@ -238,3 +238,45 @@ def test_recently_removed_broker_retention():
     assert ex.recently_removed_brokers(now_ms=2000) == set()
     ex.add_recently_demoted_brokers([1], now_ms=0)
     assert ex.recently_demoted_brokers(now_ms=100) == {1}
+
+
+def test_topic_min_isr_cache_and_pressure():
+    """TopicMinIsrCache TTL + the adjuster's (At/Under)MinISR gate
+    (common/TopicMinIsrCache.java, Executor.java:335-447)."""
+    from cruise_control_tpu.executor.min_isr import (TopicMinIsrCache,
+                                                     min_isr_pressure)
+
+    calls = []
+
+    class Admin:
+        def min_isr(self, topic):
+            calls.append(topic)
+            return 2
+
+    cache = TopicMinIsrCache(Admin(), ttl_ms=60_000)
+    assert cache.min_isr("t") == 2
+    assert cache.min_isr("t") == 2
+    assert calls == ["t"]  # second read cached
+
+    brokers = tuple(BrokerInfo(i, rack="r", host=f"h{i}") for i in range(3))
+    healthy = ClusterMetadata(brokers=brokers, partitions=(
+        PartitionInfo("t", 0, leader=0, replicas=(0, 1, 2)),))
+    assert not min_isr_pressure(healthy, cache)
+
+    # One replica offline → in-sync == min ISR → pressure.
+    pressured = ClusterMetadata(brokers=brokers, partitions=(
+        PartitionInfo("t", 0, leader=0, replicas=(0, 1, 2),
+                      offline_replicas=(2,)),))
+    assert min_isr_pressure(pressured, cache)
+
+
+def test_env_substitution_in_properties(tmp_path, monkeypatch):
+    """${env:VAR} indirection in config values (EnvConfigProvider)."""
+    from cruise_control_tpu.config.configdef import load_properties
+    monkeypatch.setenv("CC_TEST_BOOTSTRAP", "broker1:9092")
+    p = tmp_path / "cc.properties"
+    p.write_text("bootstrap.servers=${env:CC_TEST_BOOTSTRAP}\n"
+                 "webserver.http.address=${env:CC_TEST_UNSET}\n")
+    props = load_properties(str(p))
+    assert props["bootstrap.servers"] == "broker1:9092"
+    assert props["webserver.http.address"] == ""

@@ -276,3 +276,16 @@ def test_sample_store_warm_start_rebuilds_windows(client, broker):
     from cruise_control_tpu.monitor.load_monitor import NotEnoughValidWindowsError
     with pytest.raises(NotEnoughValidWindowsError):
         lm3.cluster_model()
+
+
+def test_read_only_sample_store(client, broker):
+    """ReadOnlyKafkaSampleStore replays but never writes."""
+    store = KafkaSampleStore(client)
+    store.store_samples(Samples(
+        [PartitionMetricSample("payload", 0, 0, 1, {"CPU_USAGE": 0.2})], []))
+    ro = store.read_only()
+    loaded = ro.load_samples()
+    assert len(loaded.partition_samples) == 1
+    ro.store_samples(Samples(
+        [PartitionMetricSample("payload", 1, 0, 2, {"CPU_USAGE": 0.3})], []))
+    assert len(store.load_samples().partition_samples) == 1  # nothing written
