@@ -345,9 +345,15 @@ def _goal_step(model: TensorClusterModel, options: OptimizationOptions,
             lambda x: jax.lax.with_sharding_constraint(x, sharding), cand)
 
     feasible = kernels.self_feasible(spec, model, arrays, cand, constraint)
-    accepted = jnp.ones_like(feasible)
+    # Band-kind prev goals' vetoes batch into one stacked mask chain; the
+    # structural kinds (rack, topic counts, min-leaders, intra-disk) keep
+    # their dedicated accepts.
+    accepted = kernels.accepts_band_batch(prev_specs, model, arrays, cand,
+                                          constraint)
     for prev in prev_specs:
-        accepted = accepted & kernels.accepts(prev, model, arrays, cand, constraint)
+        if not kernels.is_band_kind(prev):
+            accepted = accepted & kernels.accepts(prev, model, arrays, cand,
+                                                  constraint)
     score = kernels.score(spec, model, arrays, cand, constraint)
 
     eligible = cand.valid & feasible & accepted & (score > _MIN_SCORE)

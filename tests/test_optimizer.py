@@ -164,3 +164,37 @@ def test_segmented_fixpoint_matches_unsegmented():
     rb_a = np.asarray(whole.model.replica_broker)
     rb_b = np.asarray(segmented.model.replica_broker)
     np.testing.assert_array_equal(rb_a, rb_b)
+
+
+def test_batched_band_accepts_matches_per_spec():
+    """accepts_band_batch must equal the AND-fold of per-spec accepts (it
+    only restructures the math into stacked tensors)."""
+    import jax.numpy as jnp
+
+    from cruise_control_tpu.analyzer import candidates as cgen
+    from cruise_control_tpu.analyzer.balancing_constraint import BalancingConstraint
+    from cruise_control_tpu.analyzer.goals import kernels
+    from cruise_control_tpu.analyzer.goals.specs import goals_by_priority
+    from cruise_control_tpu.analyzer.state import BrokerArrays, OptimizationOptions
+
+    spec = ClusterSpec(num_brokers=6, num_racks=3, num_topics=4,
+                       mean_partitions_per_topic=10.0, seed=21)
+    model = generate_cluster(spec)
+    arrays = BrokerArrays.from_model(model)
+    constraint = BalancingConstraint.default()
+    options = OptimizationOptions.none(model)
+    prev = tuple(goals_by_priority([
+        "ReplicaCapacityGoal", "DiskCapacityGoal", "NetworkInboundCapacityGoal",
+        "CpuCapacityGoal", "ReplicaDistributionGoal", "PotentialNwOutGoal",
+        "DiskUsageDistributionGoal", "LeaderReplicaDistributionGoal",
+        "LeaderBytesInDistributionGoal"]))
+    goal = goals_by_priority(["NetworkOutboundUsageDistributionGoal"])[0]
+    cand = cgen.move_candidates(goal, model, arrays, constraint, options, 32, 6)
+    lead = cgen.leadership_candidates(goal, model, arrays, constraint, options, 16)
+    swaps = cgen.swap_candidates(goal, model, arrays, constraint, options, 16, 4)
+    for batch in (cand, lead, swaps):
+        folded = jnp.ones(batch.k, bool)
+        for s in prev:
+            folded = folded & kernels.accepts(s, model, arrays, batch, constraint)
+        batched = kernels.accepts_band_batch(prev, model, arrays, batch, constraint)
+        np.testing.assert_array_equal(np.asarray(batched), np.asarray(folded))
