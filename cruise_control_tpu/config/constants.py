@@ -66,11 +66,16 @@ DEFAULT_GOAL_NAMES = [
     "LeaderBytesInDistributionGoal",
 ]
 
-# Extended goal set; entries are appended here as their kernels land
-# (kafka-assigner modes, preferred-leader election, min-topic-leaders are
-# tracked in the build plan and join this list with their implementations).
+# Every registered goal (GOAL_SPECS) — the full 21-goal surface of the
+# reference (config/cruisecontrol.properties:98-126 lists the same set).
 SUPPORTED_GOAL_NAMES = DEFAULT_GOAL_NAMES + [
     "RackAwareDistributionGoal",
+    "MinTopicLeadersPerBrokerGoal",
+    "PreferredLeaderElectionGoal",
+    "IntraBrokerDiskCapacityGoal",
+    "IntraBrokerDiskUsageDistributionGoal",
+    "KafkaAssignerEvenRackAwareGoal",
+    "KafkaAssignerDiskUsageDistributionGoal",
 ]
 
 HARD_GOAL_NAMES = [
