@@ -518,12 +518,19 @@ def accepts(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
     d_src, d_dest = _candidate_deltas(spec, cand)
     dest_after = metric[cand.dest] + d_dest
     src_after = metric[cand.src] + d_src
+    # Both legs bound against the upper limit: a swap's net exchange can GAIN
+    # load on the source broker (d_src = -d_dest > 0), which must not push it
+    # over an already-optimized cap (CapacityGoal.actionAcceptance evaluates
+    # both brokers of an INTER_BROKER_REPLICA_SWAP).  For plain moves
+    # d_src <= 0, so the source-side check passes trivially.
     dest_ok = (dest_after <= upper[cand.dest]) | (d_dest <= 0)
+    src_cap_ok = (src_after <= upper[cand.src]) | (d_src <= 0)
     if spec.is_hard or kind in ("potential_nw_out", "leader_bytes_in"):
-        # Cap-style goals only bound the destination.
-        return dest_ok
+        # Cap-style goals bound only the upper limit — on BOTH brokers.
+        return dest_ok & src_cap_ok
     src_ok = (src_after >= lower[cand.src]) | (d_src >= 0) | (~arrays.alive[cand.src])
-    return dest_ok & src_ok
+    dest_low_ok = (dest_after >= lower[cand.dest]) | (d_dest >= 0)
+    return dest_ok & src_cap_ok & src_ok & dest_low_ok
 
 
 def score(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
@@ -820,7 +827,11 @@ def accepts_band_batch(specs, model: TensorClusterModel, arrays: BrokerArrays,
 
     dest_after = metric[:, cand.dest] + d_dest
     src_after = metric[:, cand.src] + d_src
+    # Mirrors accepts(): upper-limit checks on BOTH legs (swap source gains),
+    # lower-limit checks on both legs for band goals.
     dest_ok = (dest_after <= upper[:, cand.dest]) | (d_dest <= 0)
+    src_cap_ok = (src_after <= upper[:, cand.src]) | (d_src <= 0)
     src_ok = (src_after >= lower[:, cand.src]) | (d_src >= 0) | \
         (~arrays.alive[cand.src])[None, :]
-    return (dest_ok & (cap_style | src_ok)).all(axis=0)
+    dest_low_ok = (dest_after >= lower[:, cand.dest]) | (d_dest >= 0)
+    return (dest_ok & src_cap_ok & (cap_style | (src_ok & dest_low_ok))).all(axis=0)

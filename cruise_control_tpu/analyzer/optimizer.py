@@ -226,9 +226,15 @@ def select_batched(score: Array, cand: Candidates, eligible: Array,
     for _ in range(rounds):
         elig = eligible & ~keep_total & ~used_part[cand.partition] & \
             ~used_part[cand.partition2]
+        # Each role's cumulative deltas stay inside [-shed slack, gain room]:
+        # swaps make d_src positive (source gains) / d_dest negative (dest
+        # sheds), so BOTH bounds apply to both roles — one-sided checks let a
+        # swap push its source broker over an optimized cap undetected.
         budget_ok = (
             (cum_dest[cand.dest] + d_dest <= room_dest[cand.dest] + eps) &
-            (cum_src[cand.src] + d_src >= -slack_src[cand.src] - eps)
+            (cum_dest[cand.dest] + d_dest >= -slack_src[cand.dest] - eps) &
+            (cum_src[cand.src] + d_src >= -slack_src[cand.src] - eps) &
+            (cum_src[cand.src] + d_src <= room_dest[cand.src] + eps)
         ).all(axis=1)
         elig = elig & budget_ok
         if topic_guard:
@@ -268,13 +274,15 @@ def select_batched(score: Array, cand: Candidates, eligible: Array,
         km = keep[:, None]
         sum_dest = jnp.zeros_like(cum_dest).at[jnp.where(keep, cand.dest, 0)].add(
             jnp.where(km, d_dest, 0.0))
-        viol_d = (cum_dest + sum_dest > room_dest + eps).any(axis=1)
+        viol_d = ((cum_dest + sum_dest > room_dest + eps) |
+                  (cum_dest + sum_dest < -slack_src - eps)).any(axis=1)
         top1_dest = _best_per_segment(score, cand.dest, num_brokers, keep)
         keep = keep & (~viol_d[cand.dest] | top1_dest)
         km = keep[:, None]
         sum_src = jnp.zeros_like(cum_src).at[jnp.where(keep, cand.src, 0)].add(
             jnp.where(km, d_src, 0.0))
-        viol_s = (cum_src + sum_src < -slack_src - eps).any(axis=1)
+        viol_s = ((cum_src + sum_src < -slack_src - eps) |
+                  (cum_src + sum_src > room_dest + eps)).any(axis=1)
         top1_src = _best_per_segment(score, cand.src, num_brokers, keep)
         keep = keep & (~viol_s[cand.src] | top1_src)
 
@@ -646,6 +654,15 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
         # over), a few extra host syncs.
         if segment_steps is None and group == 1 and model.num_brokers >= 500:
             segment_steps = 32
+        if segment_steps is not None and group > 1:
+            # The segmented loop reads ONE goal's packed stats per dispatch
+            # (packed[:, 0]); a multi-goal chunk would silently drop every
+            # other goal's stats and misindex the per-spec results below.
+            if fuse_group_size is not None and fuse_group_size > 1:
+                raise ValueError(
+                    "segment_steps requires per-goal chunking; pass "
+                    "fuse_group_size=1 (or omit it) when segmenting")
+            group = 1
         packed_rows = []
         prev: Tuple[GoalSpec, ...] = ()
         for start in range(0, len(specs), group):

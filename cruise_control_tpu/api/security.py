@@ -73,17 +73,23 @@ class JwtSecurityProvider(SecurityProvider):
             if not hmac.compare_digest(expected, _b64url_decode(sig_part)):
                 return None
             claims = json.loads(_b64url_decode(body_part))
-        except (ValueError, KeyError):
+            if not isinstance(claims, dict):
+                return None
+            exp = claims.get("exp")
+            if exp is not None and time.time() > float(exp):
+                return None
+            if self._issuer is not None and claims.get("iss") != self._issuer:
+                return None
+            roles = claims.get(self._roles_claim, [])
+            if isinstance(roles, str):
+                roles = [roles]
+            granted = [r.upper() for r in roles
+                       if isinstance(r, str) and r.upper() in _ROLES]
+        except (ValueError, KeyError, TypeError, AttributeError):
+            # Malformed tokens (non-dict header/claims, non-numeric exp,
+            # non-string roles, …) are an authentication failure (401),
+            # never a 500.
             return None
-        exp = claims.get("exp")
-        if exp is not None and time.time() > float(exp):
-            return None
-        if self._issuer is not None and claims.get("iss") != self._issuer:
-            return None
-        roles = claims.get(self._roles_claim, [])
-        if isinstance(roles, str):
-            roles = [roles]
-        granted = [r.upper() for r in roles if r.upper() in _ROLES]
         if not granted:
             return self._default_role
         # Highest granted role wins.

@@ -30,8 +30,18 @@ from cruise_control_tpu.config import constants as C
 def _parse_bootstrap(value: List[str]) -> List[Tuple[str, int]]:
     out = []
     for entry in value:
-        host, _, port = entry.rpartition(":")
-        out.append((host or "127.0.0.1", int(port)))
+        if not entry:
+            raise ValueError(
+                "invalid bootstrap.servers: empty entry (trailing comma?)")
+        if ":" in entry:
+            host, _, port = entry.rpartition(":")
+        else:  # bare hostname — default the Kafka port
+            host, port = entry, "9092"
+        try:
+            out.append((host or "127.0.0.1", int(port)))
+        except ValueError:
+            raise ValueError(
+                f"invalid bootstrap.servers entry {entry!r}: expected host[:port]")
     return out
 
 

@@ -19,6 +19,10 @@ from tests.kafka_fake_broker import FakeKafkaBroker
 def test_parse_bootstrap():
     assert _parse_bootstrap(["a:1", "b:2"]) == [("a", 1), ("b", 2)]
     assert _parse_bootstrap([":9092"]) == [("127.0.0.1", 9092)]
+    # Bare hostname defaults the Kafka port instead of crashing.
+    assert _parse_bootstrap(["kafka1"]) == [("kafka1", 9092)]
+    with pytest.raises(ValueError, match="kafka1:x"):
+        _parse_bootstrap(["kafka1:x"])
 
 
 def test_app_boots_in_memory(tmp_path):

@@ -44,6 +44,16 @@ def test_jwt_expiry_and_issuer():
     assert p.authenticate(wrong_iss) is None
 
 
+def test_jwt_malformed_claims_reject_not_crash():
+    """Non-numeric exp / non-string roles entries are a 401-style rejection,
+    never an uncaught exception (round-3 advisor finding)."""
+    p = JwtSecurityProvider(SECRET)
+    assert p.authenticate(_bearer({"roles": ["ADMIN"], "exp": "soon"})) is None
+    assert p.authenticate(_bearer({"roles": [42, {"x": 1}]})) is None
+    # Mixed list: invalid entries are skipped, valid ones still grant.
+    assert p.authenticate(_bearer({"roles": [42, "ADMIN"]})) == ROLE_ADMIN
+
+
 def test_jwt_rejects_alg_none():
     import base64
     header = base64.urlsafe_b64encode(

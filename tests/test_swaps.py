@@ -182,6 +182,68 @@ def test_intra_broker_disk_swap():
     assert abs(disk_load[0] - disk_load[1]) < np.ptp(before), disk_load
 
 
+def test_swap_source_gain_vetoed_by_capacity_goal():
+    """A swap whose net exchange GAINS load on the source broker must be
+    vetoed by a previously-optimized capacity goal when the gain pushes the
+    source over its cap — the reference's CapacityGoal.actionAcceptance
+    evaluates BOTH brokers of an INTER_BROKER_REPLICA_SWAP (round-3 advisor
+    finding: dest-only checks pass trivially when d_dest <= 0)."""
+    import jax.numpy as jnp
+
+    from cruise_control_tpu.analyzer.actions import make_swap_candidates
+    from cruise_control_tpu.analyzer.goals import kernels
+    from cruise_control_tpu.analyzer.goals.specs import GOAL_SPECS
+    from cruise_control_tpu.analyzer.state import BrokerArrays
+
+    # b0 (cap 50 → upper 40) holds r0=10; b1 (cap 1000) holds r1=50.
+    # Swapping r0↔r1 sheds 40 from b1 (d_dest=-40, dest check trivially ok)
+    # but lands b0 at 50 > 40 — must be rejected on the source leg.
+    load = np.zeros((2, 4), np.float32)
+    load[:, 3] = [10.0, 50.0]
+    cap = np.full((2, 4), 1e9, np.float32)
+    cap[0, 3] = 50.0
+    cap[1, 3] = 1000.0
+    model = build_model(
+        replica_broker=np.array([0, 1], np.int32),
+        replica_partition=np.array([0, 1], np.int32),
+        replica_topic=np.zeros(2, np.int32),
+        replica_is_leader=np.ones(2, bool),
+        replica_load_leader=load,
+        replica_load_follower=load.copy(),
+        broker_capacity=cap,
+        broker_rack=np.array([0, 1], np.int32),
+    )
+    spec = GOAL_SPECS["DiskCapacityGoal"]
+    arrays = BrokerArrays.from_model(model)
+    constraint = BalancingConstraint.default()
+    cand = make_swap_candidates(model, jnp.array([0], jnp.int32),
+                                jnp.array([1], jnp.int32),
+                                jnp.array([True]))
+    ok = np.asarray(kernels.accepts(spec, model, arrays, cand, constraint))
+    assert not ok[0], "capacity goal must veto the source-gaining swap"
+    ok_b = np.asarray(kernels.accepts_band_batch(
+        [spec], model, arrays, cand, constraint))
+    assert not ok_b[0], "batched acceptance must mirror accepts()"
+    # Sanity: the same swap against a roomy source (cap 1000) is accepted.
+    cap2 = cap.copy()
+    cap2[0, 3] = 1000.0
+    model2 = build_model(
+        replica_broker=np.array([0, 1], np.int32),
+        replica_partition=np.array([0, 1], np.int32),
+        replica_topic=np.zeros(2, np.int32),
+        replica_is_leader=np.ones(2, bool),
+        replica_load_leader=load,
+        replica_load_follower=load.copy(),
+        broker_capacity=cap2,
+        broker_rack=np.array([0, 1], np.int32),
+    )
+    arrays2 = BrokerArrays.from_model(model2)
+    cand2 = make_swap_candidates(model2, jnp.array([0], jnp.int32),
+                                 jnp.array([1], jnp.int32),
+                                 jnp.array([True]))
+    assert np.asarray(kernels.accepts(spec, model2, arrays2, cand2, constraint))[0]
+
+
 def test_swap_partition_uniqueness():
     """One step never applies two actions touching the same partition, even
     when one of them touches it as the swap partner (partition2)."""
