@@ -3,8 +3,8 @@
 Primary metric (BASELINE.json): candidate plans scored/sec/chip and
 wall-clock to a goal-satisfying proposal.  The north-star rung is a
 7k-broker / 1M-replica model in < 30 s on a v5e-8; this bench runs the
-ladder rung selected by ``BENCH_SCALE`` (small | mid | large | xl, default
-mid = 50 brokers / ~10k replicas, BASELINE.md ladder) with the full
+ladder rung(s) selected by ``BENCH_SCALE`` (small | mid | large | xl, a
+comma list, or ``ladder`` = small,mid,large; default mid) with the full
 hard+soft goal stack, excludes compile time (one warm-up pass over cached
 compiled graphs), and prints exactly one JSON line:
 
@@ -13,14 +13,28 @@ compiled graphs), and prints exactly one JSON line:
 ``vs_baseline`` is the speedup against the north-star 30 s budget scaled to
 the rung's replica count (30 s × replicas / 1M) — > 1.0 means faster than
 the scaled target.
+
+Wedge-proofing (the tunneled TPU backend can hang indefinitely at init or
+mid-compile — round-3's capture died this way):
+
+- Backend init runs under a hard deadline (``BENCH_INIT_TIMEOUT_S``,
+  default 420 s — a healthy tunnel takes ~3-5 min for first init).  On
+  expiry the process re-execs itself ONCE for a fresh connection attempt;
+  a second expiry emits ``{"error": "backend_unavailable", ...}`` and
+  exits 3 — a parseable diagnostic, not a stack trace after minutes.
+- Each rung runs under its own deadline (``BENCH_RUNG_TIMEOUT_S``,
+  default 1800 s).  Completed rungs are appended to ``BENCH_PARTIAL.jsonl``
+  and echoed to stderr IMMEDIATELY, so a later wedge cannot erase earlier
+  results; the final stdout line carries every completed rung.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
+import threading
 import time
-
 
 SCALES = {
     # name: (brokers, racks, topics, mean parts/topic, rf) — parts × rf ≈ replicas
@@ -39,17 +53,57 @@ STACK = [
     "LeaderReplicaDistributionGoal", "LeaderBytesInDistributionGoal",
 ]
 
+_completed: list = []  # rung records finished so far (read by the watchdog)
+_PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_PARTIAL.jsonl")
 
-def main() -> None:
-    scale = os.environ.get("BENCH_SCALE", "mid")
-    # Optional width cap (K budget per goal step): the xl rung's full-width
-    # programs hang the tunneled remote-compile service; a bounded batch
-    # compiles reliably and the lanes make up the throughput.
-    max_candidates = int(os.environ.get("BENCH_MAX_CANDIDATES", "0")) or None
-    # BENCH_FAST=1 runs the stack in fast_mode (narrower batches, quartered
-    # step budget) — the xl rung's full fixpoints are hours of single-chip
-    # device time; a labeled fast-mode record beats no record.
-    fast = bool(int(os.environ.get("BENCH_FAST", "0")))
+
+def _emit_and_exit(payload: dict, rc: int) -> None:
+    print(json.dumps(payload), flush=True)
+    os._exit(rc)
+
+
+def _watchdog(seconds: float, phase: str, retry_exec: bool = False):
+    """Arm a deadline for one phase; returns cancel().  On expiry: either
+    re-exec the process for one fresh attempt (``retry_exec``, backend init
+    only) or emit a diagnostic JSON line carrying every completed rung and
+    exit 3."""
+
+    def fire():
+        if retry_exec and os.environ.get("BENCH_RETRY") != "1":
+            os.environ["BENCH_RETRY"] = "1"
+            sys.stderr.write(f"bench: {phase} deadline ({seconds:.0f}s) hit; "
+                             "re-execing for one retry\n")
+            sys.stderr.flush()
+            os.execv(sys.executable, [sys.executable] + sys.argv)
+        _emit_and_exit({
+            "metric": "bench_error",
+            "value": -1.0,
+            "unit": "s",
+            "vs_baseline": 0.0,
+            "error": phase,
+            "timeout_s": seconds,
+            "rungs": _completed,
+        }, 3)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t.cancel
+
+
+def _record_rung(rec: dict) -> None:
+    _completed.append(rec)
+    sys.stderr.write(json.dumps(rec) + "\n")
+    sys.stderr.flush()
+    try:
+        with open(_PARTIAL_PATH, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError:
+        pass  # partial-results file is best-effort
+
+
+def run_rung(scale: str, max_candidates, fast: bool) -> dict:
     brokers, racks, topics, ppt, rf = SCALES[scale]
 
     from cruise_control_tpu.analyzer import optimizer as opt
@@ -71,7 +125,7 @@ def main() -> None:
     # Warm-up: compile the fused stack program (cached for the timed run).
     # optimize() chunks the fusion automatically at ≥100 brokers (the
     # one-program 15-goal compile kernel-faults the TPU worker at 200-broker
-    # shapes — chunks of 5 compile and run fine).
+    # shapes — chunks compile and run fine).
     opt.optimize(model, STACK, raise_on_hard_failure=False, fused=True,
                  max_candidates_per_step=max_candidates, fast_mode=fast)
 
@@ -85,7 +139,7 @@ def main() -> None:
     plans_per_s = run.num_candidates_scored / max(wall_s, 1e-9)
     # North-star budget scaled to this rung's replica count.
     budget_s = 30.0 * num_replicas / 1_000_000
-    print(json.dumps({
+    return {
         "metric": f"wall_clock_to_goal_satisfying_proposal_{scale}",
         "value": round(wall_s, 3),
         "unit": "s",
@@ -97,7 +151,56 @@ def main() -> None:
         "hard_goals_satisfied": hard_ok,
         "candidates_scored": run.num_candidates_scored,
         **({"fast_mode": True} if fast else {}),
-    }))
+    }
+
+
+def main() -> None:
+    scale_env = os.environ.get("BENCH_SCALE", "mid")
+    scales = (["small", "mid", "large"] if scale_env == "ladder"
+              else [s.strip() for s in scale_env.split(",") if s.strip()])
+    if not scales or any(s not in SCALES for s in scales):
+        _emit_and_exit({"metric": "bench_error", "value": -1.0, "unit": "s",
+                        "vs_baseline": 0.0,
+                        "error": f"invalid BENCH_SCALE {scale_env!r}"}, 2)
+    max_candidates = int(os.environ.get("BENCH_MAX_CANDIDATES", "0")) or None
+    fast = bool(int(os.environ.get("BENCH_FAST", "0")))
+    if os.environ.get("BENCH_RETRY") != "1":
+        # Fresh run: drop stale partial records so recovered results can't
+        # mix runs (the re-exec retry keeps the same run's file).
+        try:
+            os.unlink(_PARTIAL_PATH)
+        except OSError:
+            pass
+    init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT_S", "420"))
+    rung_timeout = float(os.environ.get("BENCH_RUNG_TIMEOUT_S", "1800"))
+
+    # Phase 1: backend init under a deadline, one re-exec retry.
+    cancel = _watchdog(init_timeout, "backend_unavailable", retry_exec=True)
+    t_init = time.monotonic()
+    import jax
+    if os.environ.get("BENCH_PLATFORM"):  # e.g. "cpu" for harness smoke tests
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    platform = jax.devices()[0].platform
+    init_s = time.monotonic() - t_init
+    cancel()
+
+    # Phase 2: the rungs, each under its own deadline.
+    for s in scales:
+        cancel = _watchdog(rung_timeout, f"rung_timeout_{s}")
+        rec = run_rung(s, max_candidates, fast)
+        cancel()
+        rec["backend"] = platform
+        rec["backend_init_s"] = round(init_s, 1)
+        _record_rung(rec)
+
+    # One final stdout line: the headline rung (mid when present, else the
+    # last completed) with every rung's record attached.
+    headline = next((r for r in _completed
+                     if r["metric"].endswith("_mid")), _completed[-1])
+    out = dict(headline)
+    if len(_completed) > 1:
+        out["rungs"] = _completed
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":

@@ -226,15 +226,19 @@ def select_batched(score: Array, cand: Candidates, eligible: Array,
     for _ in range(rounds):
         elig = eligible & ~keep_total & ~used_part[cand.partition] & \
             ~used_part[cand.partition2]
-        # Each role's cumulative deltas stay inside [-shed slack, gain room]:
-        # swaps make d_src positive (source gains) / d_dest negative (dest
-        # sheds), so BOTH bounds apply to both roles — one-sided checks let a
-        # swap push its source broker over an optimized cap undetected.
+        # Each broker's cumulative NET delta (src-role + dest-role — a broker
+        # can shed via one action and gain via another in the same step)
+        # stays inside [-shed slack, gain room].  Swaps make d_src positive
+        # (source gains) / d_dest negative (dest sheds), so BOTH bounds apply
+        # to both roles — one-sided per-role checks let a swap push its
+        # source broker over an optimized cap undetected, and separate
+        # per-role accumulators allowed up to 2× room in one step.
+        cum_net = cum_src + cum_dest
         budget_ok = (
-            (cum_dest[cand.dest] + d_dest <= room_dest[cand.dest] + eps) &
-            (cum_dest[cand.dest] + d_dest >= -slack_src[cand.dest] - eps) &
-            (cum_src[cand.src] + d_src >= -slack_src[cand.src] - eps) &
-            (cum_src[cand.src] + d_src <= room_dest[cand.src] + eps)
+            (cum_net[cand.dest] + d_dest <= room_dest[cand.dest] + eps) &
+            (cum_net[cand.dest] + d_dest >= -slack_src[cand.dest] - eps) &
+            (cum_net[cand.src] + d_src >= -slack_src[cand.src] - eps) &
+            (cum_net[cand.src] + d_src <= room_dest[cand.src] + eps)
         ).all(axis=1)
         elig = elig & budget_ok
         if topic_guard:
@@ -271,20 +275,39 @@ def select_batched(score: Array, cand: Candidates, eligible: Array,
         # Budget-exactness for multi-landings: per-broker sums of this
         # round's kept deltas vs the REMAINING budgets; a violating broker
         # falls back to its single best kept action.
-        km = keep[:, None]
-        sum_dest = jnp.zeros_like(cum_dest).at[jnp.where(keep, cand.dest, 0)].add(
-            jnp.where(km, d_dest, 0.0))
-        viol_d = ((cum_dest + sum_dest > room_dest + eps) |
-                  (cum_dest + sum_dest < -slack_src - eps)).any(axis=1)
+        def round_net(k):
+            km = k[:, None]
+            s = jnp.zeros_like(cum_net).at[jnp.where(k, cand.dest, 0)].add(
+                jnp.where(km, d_dest, 0.0))
+            s = s.at[jnp.where(k, cand.src, 0)].add(jnp.where(km, d_src, 0.0))
+            return s
+
+        def net_viol(k):
+            total = cum_net + round_net(k)
+            return ((total > room_dest + eps) |
+                    (total < -slack_src - eps)).any(axis=1)
+
+        # Exactness stages: a net-violating broker first falls back to its
+        # single best dest-role action, then its single best src-role action
+        # (preserves throughput for near-budget brokers); any broker STILL
+        # violating — including brokers flipped into violation by another
+        # broker's drops (removing one leg of a compensating pair raises the
+        # partner's net) — sheds ALL its actions until no violation remains.
+        # The loop is monotone (a violating broker always has a kept action
+        # to drop, since cum_net alone respects the bounds by induction), so
+        # it terminates and the post-step state respects every band exactly.
+        viol = net_viol(keep)
         top1_dest = _best_per_segment(score, cand.dest, num_brokers, keep)
-        keep = keep & (~viol_d[cand.dest] | top1_dest)
-        km = keep[:, None]
-        sum_src = jnp.zeros_like(cum_src).at[jnp.where(keep, cand.src, 0)].add(
-            jnp.where(km, d_src, 0.0))
-        viol_s = ((cum_src + sum_src < -slack_src - eps) |
-                  (cum_src + sum_src > room_dest + eps)).any(axis=1)
+        keep = keep & (~viol[cand.dest] | top1_dest)
+        viol = net_viol(keep)
         top1_src = _best_per_segment(score, cand.src, num_brokers, keep)
-        keep = keep & (~viol_s[cand.src] | top1_src)
+        keep = keep & (~viol[cand.src] | top1_src)
+        def _drop_violators(k):
+            v = net_viol(k)
+            return k & ~v[cand.src] & ~v[cand.dest]
+
+        keep = jax.lax.while_loop(lambda k: net_viol(k).any(),
+                                  _drop_violators, keep)
 
         keep_total = keep_total | keep
         used_part = used_part.at[jnp.where(keep, cand.partition, 0)].max(keep)
