@@ -563,6 +563,10 @@ class OptimizerRun:
     stats_after: ClusterModelStats
     num_candidates_scored: int
     provision_response: object = None  # ProvisionResponse
+    # On-demand balancedness (OptimizerResult.java:117-118): 100 = no goal
+    # violated, each violated goal subtracts its priority/strictness cost.
+    balancedness_before: float = 100.0
+    balancedness_after: float = 100.0
 
     @property
     def violated_goals_before(self) -> List[str]:
@@ -597,7 +601,9 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
              fuse_group_size: Optional[int] = None,
              fast_mode: bool = False,
              max_candidates_per_step: Optional[int] = None,
-             segment_steps: Optional[int] = None) -> OptimizerRun:
+             segment_steps: Optional[int] = None,
+             balancedness_priority_weight: float = 1.1,
+             balancedness_strictness_weight: float = 1.5) -> OptimizerRun:
     """Run the goal stack in priority order (GoalOptimizer.optimizations).
 
     Each goal optimizes the model to its fixpoint, constrained by the
@@ -763,6 +769,14 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
         provision.aggregate(provision_verdict_for_goal(spec, model, constraint,
                                                        res.satisfied_after, view))
 
+    from cruise_control_tpu.analyzer.balancedness import (balancedness_cost_by_goal,
+                                                          balancedness_score)
+    costs = balancedness_cost_by_goal(specs, balancedness_priority_weight,
+                                      balancedness_strictness_weight)
     return OptimizerRun(model=model, goal_results=results, stats_before=stats_before,
                         stats_after=compute_stats_jit(model), num_candidates_scored=scored,
-                        provision_response=provision)
+                        provision_response=provision,
+                        balancedness_before=balancedness_score(
+                            costs, [g.name for g in results if not g.satisfied_before]),
+                        balancedness_after=balancedness_score(
+                            costs, [g.name for g in results if not g.satisfied_after]))

@@ -19,6 +19,7 @@ This facade is also the self-healing context consumed by
 from __future__ import annotations
 
 import dataclasses
+import re
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -30,7 +31,9 @@ from cruise_control_tpu.analyzer import optimizer as opt
 from cruise_control_tpu.analyzer import proposals as props
 from cruise_control_tpu.analyzer.balancing_constraint import BalancingConstraint
 from cruise_control_tpu.analyzer.goals.specs import (DEFAULT_GOAL_ORDER,
-                                                     DEFAULT_HARD_GOALS, GOAL_SPECS)
+                                                     DEFAULT_HARD_GOALS,
+                                                     GOAL_SPECS,
+                                                     INTRA_BROKER_GOAL_ORDER)
 from cruise_control_tpu.analyzer.state import OptimizationOptions
 from cruise_control_tpu.analyzer.verifier import VerificationError, verify_run
 from cruise_control_tpu.executor.admin import ClusterAdmin, ReassignmentRequest
@@ -58,6 +61,9 @@ class OperationResult:
     # Goals whose step loop hit max_steps while still applying actions: the
     # run may not be a true fixpoint for them (GoalResult.capped).
     capped_goals: List[str] = dataclasses.field(default_factory=list)
+    # On-demand balancedness (OptimizerResult.java:117-118).
+    balancedness_before: float = 100.0
+    balancedness_after: float = 100.0
 
     def to_dict(self) -> Dict[str, object]:
         out = {
@@ -72,6 +78,8 @@ class OperationResult:
             "statsAfter": self.stats_after,
             "reason": self.reason,
             "cappedGoals": self.capped_goals,
+            "onDemandBalancednessScoreBefore": round(self.balancedness_before, 3),
+            "onDemandBalancednessScoreAfter": round(self.balancedness_after, 3),
         }
         if self.execution is not None:
             out["execution"] = dataclasses.asdict(self.execution)
@@ -87,17 +95,39 @@ class CruiseControl:
                  requirements: Optional[ModelCompletenessRequirements] = None,
                  proposal_expiration_ms: int = 60_000,
                  max_steps_per_goal: int = 256,
-                 max_candidates_per_step: Optional[int] = None):
+                 max_candidates_per_step: Optional[int] = None,
+                 balancedness_priority_weight: float = 1.1,
+                 balancedness_strictness_weight: float = 1.5,
+                 supported_goals: Optional[Sequence[str]] = None,
+                 intra_broker_goals: Optional[Sequence[str]] = None,
+                 allow_capacity_estimation: bool = True,
+                 excluded_topics_pattern: Optional[str] = None,
+                 self_healing_exclude_recently_demoted: bool = True,
+                 self_healing_exclude_recently_removed: bool = True):
         self.load_monitor = load_monitor
         self.executor = executor
         self.admin = admin
         self.goals = list(goals or DEFAULT_GOAL_ORDER)
         self.hard_goals = list(hard_goals or DEFAULT_HARD_GOALS)
+        # goals (AnalyzerConfig GOALS_CONFIG): every requestable goal; a
+        # request naming a goal outside it is rejected up front.
+        self.supported_goals = list(supported_goals or GOAL_SPECS)
+        # intra.broker.goals: the stack for rebalance_disk=true requests.
+        self.intra_broker_goals = list(intra_broker_goals or
+                                       INTRA_BROKER_GOAL_ORDER)
+        self.allow_capacity_estimation = allow_capacity_estimation
+        # topics.excluded.from.partition.movement (a regex in the reference).
+        self._excluded_topics_pattern = (re.compile(excluded_topics_pattern)
+                                         if excluded_topics_pattern else None)
+        self._self_heal_exclude_demoted = self_healing_exclude_recently_demoted
+        self._self_heal_exclude_removed = self_healing_exclude_recently_removed
         self.constraint = constraint or BalancingConstraint.default()
         self.requirements = requirements or ModelCompletenessRequirements()
         self._proposal_expiration_ms = proposal_expiration_ms
         self._max_steps_per_goal = max_steps_per_goal
         self._max_candidates_per_step = max_candidates_per_step
+        self._balancedness_weights = (balancedness_priority_weight,
+                                      balancedness_strictness_weight)
         self._cache_lock = threading.Lock()
         self._cached: Optional[Tuple[Tuple[int, int], float, opt.OptimizerRun,
                                      List[props.ExecutionProposal]]] = None
@@ -106,7 +136,9 @@ class CruiseControl:
     # Model + optimization plumbing
     # ------------------------------------------------------------------
     def _model(self) -> TensorClusterModel:
-        return self.load_monitor.cluster_model(self.requirements)
+        return self.load_monitor.cluster_model(
+            self.requirements,
+            allow_capacity_estimation=self.allow_capacity_estimation)
 
     def _model_naming(self) -> Tuple[TensorClusterModel, Dict[str, object]]:
         """Model + id↔name maps from ONE metadata snapshot.  The tensor model
@@ -124,10 +156,71 @@ class CruiseControl:
             raise ValueError(f"unknown broker ids {missing}")
         return [to_dense[b] for b in broker_ids]
 
+    def _base_options(self, model: TensorClusterModel,
+                      naming: Dict[str, object]) -> OptimizationOptions:
+        """Default per-request options with the config-excluded topics
+        applied (topics.excluded.from.partition.movement)."""
+        options = OptimizationOptions.none(model)
+        if self._excluded_topics_pattern is not None:
+            tmask = np.array([bool(self._excluded_topics_pattern.fullmatch(t))
+                              for t in naming["topics"]], bool)
+            if tmask.any():
+                options = options.replace(topic_excluded=jnp.asarray(tmask))
+        return options
+
+    def _validate_goals(self, goals: Sequence[str]) -> None:
+        """User-requested goals must be in goals= (the supported set);
+        short and fully-qualified names both resolve, as in
+        goals_by_priority.  Internal stacks (demote's
+        PreferredLeaderElectionGoal, self-healing) are not gated — the
+        reference only sanity-checks request parameters against
+        GOALS_CONFIG."""
+        supported = {g.rsplit(".", 1)[-1] for g in self.supported_goals}
+        unsupported = [g for g in goals
+                       if g.rsplit(".", 1)[-1] not in supported]
+        if unsupported:
+            raise ValueError(
+                f"goals {unsupported} are not supported; supported: "
+                f"{sorted(supported)}")
+
+    def _self_heal_excludes(self, options: OptimizationOptions,
+                            naming: Dict[str, object]) -> OptimizationOptions:
+        """self.healing.exclude.recently.{removed,demoted}.brokers
+        (AnomalyDetectorConfig): an autonomous fix must not undo a recent
+        operator decision by moving replicas back onto a just-removed broker
+        or leadership onto a just-demoted one.  Applied by every
+        self-healing entry point (rebalance, fix_offline_replicas)."""
+        to_dense = {b: i for i, b in enumerate(naming["brokers"])}
+        if self._self_heal_exclude_removed:
+            removed = [to_dense[b] for b in
+                       self.executor.recently_removed_brokers()
+                       if b in to_dense]
+            if removed:
+                emask = np.array(options.broker_excluded_replica_move)
+                emask[removed] = True
+                options = options.replace(
+                    broker_excluded_replica_move=jnp.asarray(emask))
+        if self._self_heal_exclude_demoted:
+            demoted = [to_dense[b] for b in
+                       self.executor.recently_demoted_brokers()
+                       if b in to_dense]
+            if demoted:
+                lmask = np.array(options.broker_excluded_leadership)
+                lmask[demoted] = True
+                options = options.replace(
+                    broker_excluded_leadership=jnp.asarray(lmask))
+        return options
+
     def _optimize(self, model: TensorClusterModel, goals: Optional[Sequence[str]],
                   options: Optional[OptimizationOptions] = None,
-                  fast_mode: bool = False) -> opt.OptimizerRun:
+                  fast_mode: bool = False,
+                  naming: Optional[Dict[str, object]] = None) -> opt.OptimizerRun:
         goal_list = list(goals) if goals else self.goals
+        if options is None and naming is not None:
+            # Config-excluded topics apply to EVERY goal-based operation,
+            # not just /rebalance (the reference applies them in all
+            # GoalBasedOperationRunnables).
+            options = self._base_options(model, naming)
         from cruise_control_tpu.common.sensors import SENSORS
         # Requested non-hard-only goal subsets still honor hard goals first
         # (GoalBasedOperationRunnable skip-hard-goal-check semantics are an
@@ -137,7 +230,9 @@ class CruiseControl:
                                 options=options, raise_on_hard_failure=False,
                                 fused=True, fast_mode=fast_mode,
                                 max_steps_per_goal=self._max_steps_per_goal,
-                                max_candidates_per_step=self._max_candidates_per_step)
+                                max_candidates_per_step=self._max_candidates_per_step,
+                                balancedness_priority_weight=self._balancedness_weights[0],
+                                balancedness_strictness_weight=self._balancedness_weights[1])
 
     def _finish(self, model: TensorClusterModel, run: opt.OptimizerRun,
                 dryrun: bool, reason: str, naming: Dict[str, object],
@@ -163,7 +258,9 @@ class CruiseControl:
                     stats_before=run.stats_before.to_dict(),
                     stats_after=run.stats_after.to_dict(),
                     reason=f"{reason} [verification failed: {e}]",
-                    capped_goals=capped)
+                    capped_goals=capped,
+                    balancedness_before=run.balancedness_before,
+                    balancedness_after=run.balancedness_after)
         proposals = props.renumber_brokers(dense_proposals, naming["brokers"])
         execution = None
         ok = True
@@ -182,7 +279,9 @@ class CruiseControl:
             provision_status=run.provision_response.status.value,
             stats_before=run.stats_before.to_dict(),
             stats_after=run.stats_after.to_dict(),
-            execution=execution, reason=reason, capped_goals=capped)
+            execution=execution, reason=reason, capped_goals=capped,
+            balancedness_before=run.balancedness_before,
+            balancedness_after=run.balancedness_after)
 
     # ------------------------------------------------------------------
     # Proposals (cached)
@@ -208,9 +307,13 @@ class CruiseControl:
                             stats_after=crun.stats_after.to_dict(),
                             reason="cached",
                             capped_goals=[g.name for g in crun.goal_results
-                                          if g.capped])
+                                          if g.capped],
+                            balancedness_before=crun.balancedness_before,
+                            balancedness_after=crun.balancedness_after)
         model, naming = self._model_naming()
-        run = self._optimize(model, goals)
+        if goals:
+            self._validate_goals(goals)
+        run = self._optimize(model, goals, naming=naming)
         result = self._finish(model, run, dryrun=True, reason="proposals",
                               naming=naming)
         # Only verified-good runs are cacheable: a cached entry is always
@@ -231,17 +334,27 @@ class CruiseControl:
                   destination_broker_ids: Optional[Sequence[int]] = None,
                   excluded_topics: Optional[Sequence[int]] = None,
                   reason: str = "rebalance",
-                  fast_mode: bool = False) -> OperationResult:
+                  fast_mode: bool = False,
+                  rebalance_disk: bool = False,
+                  self_healing: bool = False) -> OperationResult:
         model, naming = self._model_naming()
-        options = OptimizationOptions.none(model)
+        if goals:
+            self._validate_goals(goals)
+        options = self._base_options(model, naming)
         if destination_broker_ids:
             mask = np.zeros(model.num_brokers, bool)
             mask[self._to_dense(naming, destination_broker_ids)] = True
             options = options.replace(requested_dest_only=jnp.asarray(mask))
         if excluded_topics:
-            tmask = np.zeros(model.num_topics, bool)
+            tmask = np.array(options.topic_excluded)
             tmask[list(excluded_topics)] = True
             options = options.replace(topic_excluded=jnp.asarray(tmask))
+        if self_healing:
+            options = self._self_heal_excludes(options, naming)
+        if rebalance_disk and goals is None:
+            # rebalance_disk=true runs the intra-broker (JBOD) stack
+            # (intra.broker.goals) instead of the inter-broker default.
+            goals = self.intra_broker_goals
         run = self._optimize(model, goals, options, fast_mode=fast_mode)
         return self._finish(model, run, dryrun, reason, naming)
 
@@ -252,17 +365,21 @@ class CruiseControl:
         for b in self._to_dense(naming, broker_ids):
             model = model.set_broker_state(b, BrokerState.NEW)
         self.executor.drop_recently_removed_brokers(list(broker_ids))
-        run = self._optimize(model, self.goals)
+        run = self._optimize(model, self.goals, naming=naming)
         return self._finish(model, run, dryrun, reason, naming)
 
     def remove_brokers(self, broker_ids: Sequence[int], dryrun: bool = False,
-                       reason: str = "remove_brokers") -> bool:
+                       reason: str = "remove_brokers",
+                       self_healing: bool = False) -> bool:
         """Decommission: drain all replicas off the brokers
         (RemoveBrokersRunnable)."""
         model, naming = self._model_naming()
         for b in self._to_dense(naming, broker_ids):
             model = model.set_broker_state(b, BrokerState.DEAD)
-        run = self._optimize(model, self.goals)
+        options = self._base_options(model, naming)
+        if self_healing:
+            options = self._self_heal_excludes(options, naming)
+        run = self._optimize(model, self.goals, options)
         result = self._finish(model, run, dryrun, reason, naming)
         if result.ok and not dryrun:
             self.executor.add_recently_removed_brokers(list(broker_ids))
@@ -321,11 +438,15 @@ class CruiseControl:
         return count
 
     def fix_offline_replicas(self, dryrun: bool = False,
-                             reason: str = "fix_offline_replicas") -> bool:
+                             reason: str = "fix_offline_replicas",
+                             self_healing: bool = False) -> bool:
         """Heal offline replicas via the hard-goal stack
         (FixOfflineReplicasRunnable)."""
         model, naming = self._model_naming()
-        run = self._optimize(model, self.hard_goals)
+        options = self._base_options(model, naming)
+        if self_healing:
+            options = self._self_heal_excludes(options, naming)
+        run = self._optimize(model, self.hard_goals, options)
         return self._finish(model, run, dryrun, reason, naming).ok
 
     def update_topic_replication_factor(self, topics_rf: Dict[str, int],
@@ -396,8 +517,7 @@ class CruiseControl:
             },
         }
         if detector_manager is not None:
-            out["AnomalyDetectorState"] = detector_manager.state.to_dict(
-                detector_manager.notifier)
+            out["AnomalyDetectorState"] = detector_manager.state_dict()
         from cruise_control_tpu.common.sensors import SENSORS
         out["Sensors"] = SENSORS.snapshot()
         return out

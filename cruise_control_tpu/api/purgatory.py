@@ -38,15 +38,26 @@ class ReviewRequest:
 
 
 class Purgatory:
-    def __init__(self, retention_ms: int = 7 * 24 * 3600 * 1000):
+    def __init__(self, retention_ms: int = 7 * 24 * 3600 * 1000,
+                 max_requests: int = 25):
+        # two.step.purgatory.{retention.time.ms,max.requests}
+        # (WebServerConfig): expiry of reviewed requests + a cap on parked
+        # pending reviews.
         self._lock = threading.Lock()
         self._requests: Dict[int, ReviewRequest] = {}
         self._next_id = 0
         self._retention_ms = retention_ms
+        self._max_requests = max_requests
 
     def add(self, endpoint: str, query: Dict[str, str]) -> ReviewRequest:
         with self._lock:
             self._gc()
+            pending = sum(1 for r in self._requests.values()
+                          if r.status == ReviewStatus.PENDING_REVIEW)
+            if pending >= self._max_requests:
+                raise ValueError(
+                    f"two-step purgatory is full ({pending} pending reviews >= "
+                    f"two.step.purgatory.max.requests={self._max_requests})")
             req = ReviewRequest(self._next_id, endpoint, dict(query),
                                 ReviewStatus.PENDING_REVIEW,
                                 int(time.time() * 1000))

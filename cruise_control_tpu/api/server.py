@@ -69,8 +69,17 @@ class BasicSecurityProvider(SecurityProvider):
     """HTTP Basic (servlet/security/BasicSecurityProvider.java): credentials
     {user: (password, role)}."""
 
-    def __init__(self, credentials: Dict[str, Tuple[str, str]]):
-        self._creds = credentials
+    def __init__(self, credentials: Optional[Dict[str, Tuple[str, str]]] = None):
+        self._creds = credentials if credentials is not None else {}
+
+    def configure(self, config: Dict[str, object]) -> None:
+        """Plugin-style init (webserver.security.provider): loads the
+        realm file named by webserver.auth.credentials.file."""
+        from cruise_control_tpu.app import _load_credentials
+        from cruise_control_tpu.config import constants as C
+        path = config.get(C.WEBSERVER_AUTH_CREDENTIALS_FILE_CONFIG)
+        if path:
+            self._creds = _load_credentials(str(path))
 
     def authenticate(self, headers) -> Optional[str]:
         auth = headers.get("Authorization", "")
@@ -123,12 +132,15 @@ class CruiseControlApi:
 
     def __init__(self, cc: CruiseControl, detector_manager=None, sampler=None,
                  two_step_verification: bool = False,
-                 security: Optional[SecurityProvider] = None):
+                 security: Optional[SecurityProvider] = None,
+                 user_tasks: Optional[UserTaskManager] = None,
+                 purgatory: Optional[Purgatory] = None):
         self.cc = cc
         self.detector_manager = detector_manager
         self.sampler = sampler
-        self.user_tasks = UserTaskManager()
-        self.purgatory = Purgatory() if two_step_verification else None
+        self.user_tasks = user_tasks or UserTaskManager()
+        self.purgatory = (purgatory or Purgatory()) if two_step_verification \
+            else None
         self.security = security or SecurityProvider()
         self.request_meters: Dict[str, int] = {}
         self._local = threading.local()  # per-request purgatory review key
@@ -315,13 +327,15 @@ class CruiseControlApi:
         goals = _parse_goals(q)
         dests = _parse_ids(q, "destination_broker_ids")
         fast = _parse_bool(q, "fast_mode", False)
+        rebalance_disk = _parse_bool(q, "rebalance_disk", False)
 
         def fn(progress):
             progress.add_step("GeneratingClusterModel")
             progress.add_step("OptimizationForGoals")
             return self.cc.rebalance(goals=goals, dryrun=dryrun,
                                      destination_broker_ids=dests or None,
-                                     fast_mode=fast)
+                                     fast_mode=fast,
+                                     rebalance_disk=rebalance_disk)
         return self._async("rebalance", q, fn)
 
     def _ep_add_broker(self, q):

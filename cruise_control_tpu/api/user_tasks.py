@@ -79,12 +79,16 @@ class UserTask:
 
 class UserTaskManager:
     def __init__(self, completed_retention_ms: int = 6 * 3600 * 1000,
-                 max_active_tasks: int = 25):
+                 max_active_tasks: int = 25,
+                 max_cached_completed: int = 100):
+        # max.active.user.tasks / completed.user.task.retention.time.ms /
+        # max.cached.completed.user.tasks (UserTaskManagerConfig).
         self._lock = threading.Lock()
         self._tasks: Dict[str, UserTask] = {}
         self._by_key: Dict[Tuple, str] = {}
         self._retention_ms = completed_retention_ms
         self._max_active = max_active_tasks
+        self._max_cached_completed = max_cached_completed
 
     def _gc(self, now_ms: int) -> None:
         expired = [tid for tid, t in self._tasks.items()
@@ -92,7 +96,18 @@ class UserTaskManager:
                    and now_ms - t.end_ms > self._retention_ms]
         for tid in expired:
             t = self._tasks.pop(tid)
-            self._by_key.pop(t.request_key, None)
+            # Only drop the key mapping if it still points at THIS task — a
+            # resubmitted identical request may own the key by now, and
+            # popping it would break duplicate-request joining.
+            if self._by_key.get(t.request_key) == t.task_id:
+                self._by_key.pop(t.request_key, None)
+        completed = sorted((t for t in self._tasks.values()
+                            if t.status != TaskStatus.ACTIVE),
+                           key=lambda t: t.end_ms)
+        for t in completed[:max(0, len(completed) - self._max_cached_completed)]:
+            self._tasks.pop(t.task_id, None)
+            if self._by_key.get(t.request_key) == t.task_id:
+                self._by_key.pop(t.request_key, None)
 
     def submit(self, endpoint: str, request_key: Tuple,
                fn: Callable[[OperationProgress], object],

@@ -89,10 +89,18 @@ class KafkaCruiseControlApp:
                 KafkaMetadataRefresher, cluster_metadata_from_kafka)
             from cruise_control_tpu.kafka.sample_store import KafkaSampleStore
             from cruise_control_tpu.kafka.sampler import KafkaMetricSampler
+            from cruise_control_tpu.kafka.maintenance import MAINTENANCE_TOPIC
+            from cruise_control_tpu.kafka.sample_store import (
+                BROKER_SAMPLES_TOPIC, PARTITION_SAMPLES_TOPIC)
             from cruise_control_tpu.reporter.agent import METRICS_TOPIC
 
             self._kafka_client = KafkaClient(bootstrap)
-            internal = (METRICS_TOPIC,)
+            # ALL of Cruise Control's own topics are invisible to the model:
+            # the sample-store topics never receive partition samples, so
+            # counting them deflated monitored-partition percentage below
+            # min.valid.partition.ratio on small clusters.
+            internal = (METRICS_TOPIC, PARTITION_SAMPLES_TOPIC,
+                        BROKER_SAMPLES_TOPIC, MAINTENANCE_TOPIC)
             self.metadata_client = MetadataClient(
                 cluster_metadata_from_kafka(self._kafka_client, internal))
             self._refresher = KafkaMetadataRefresher(
@@ -128,7 +136,11 @@ class KafkaCruiseControlApp:
             min_samples_per_window=cfg.get(
                 C.MIN_SAMPLES_PER_PARTITION_METRICS_WINDOW_CONFIG),
             max_allowed_extrapolations=cfg.get(
-                C.MAX_ALLOWED_EXTRAPOLATIONS_PER_PARTITION_CONFIG))
+                C.MAX_ALLOWED_EXTRAPOLATIONS_PER_PARTITION_CONFIG),
+            min_samples_per_broker_window=cfg.get(
+                C.MIN_SAMPLES_PER_BROKER_METRICS_WINDOW_CONFIG),
+            max_allowed_broker_extrapolations=cfg.get(
+                C.MAX_ALLOWED_EXTRAPOLATIONS_PER_BROKER_CONFIG))
         throttle_rate = cfg.get(C.DEFAULT_REPLICATION_THROTTLE_CONFIG)
         # The executor's wait loop must observe reassignment completion:
         # with Kafka bindings it reads a refreshing view (every poll hits
@@ -138,29 +150,89 @@ class KafkaCruiseControlApp:
                              else self.metadata_client)
         from cruise_control_tpu.executor.min_isr import (TopicMinIsrCache,
                                                          min_isr_pressure)
+        from cruise_control_tpu.executor.strategy import resolve_strategy
+        from cruise_control_tpu.executor.task_manager import ConcurrencyLimits
         isr_cache = TopicMinIsrCache(self.admin)
+        # The configured strategy inventory must resolve (replica.movement.
+        # strategies); the default chain comes from default.replica.movement.
+        # strategies (ExecutorConfig.java).
+        for name in cfg.get(C.REPLICA_MOVEMENT_STRATEGIES_CONFIG):
+            resolve_strategy([name])
+        limits = ConcurrencyLimits(
+            inter_broker_per_broker=cfg.get(
+                C.NUM_CONCURRENT_PARTITION_MOVEMENTS_PER_BROKER_CONFIG),
+            intra_broker_per_broker=cfg.get(
+                C.NUM_CONCURRENT_INTRA_BROKER_PARTITION_MOVEMENTS_CONFIG),
+            leadership_cluster=cfg.get(C.NUM_CONCURRENT_LEADER_MOVEMENTS_CONFIG),
+            max_cluster_movements=cfg.get(C.MAX_NUM_CLUSTER_MOVEMENTS_CONFIG),
+            max_cluster_partition_movements=cfg.get(
+                C.MAX_NUM_CLUSTER_PARTITION_MOVEMENTS_CONFIG))
         self.executor = Executor(
             self.admin, executor_metadata,
+            limits=limits,
+            strategy=resolve_strategy(
+                cfg.get(C.DEFAULT_REPLICA_MOVEMENT_STRATEGIES_CONFIG)),
             throttle_rate_bytes_per_sec=(
                 throttle_rate if throttle_rate and throttle_rate > 0 else None),
+            removed_broker_retention_ms=cfg.get(
+                C.REMOVED_BROKERS_RETENTION_MS_CONFIG),
+            demoted_broker_retention_ms=cfg.get(
+                C.DEMOTED_BROKERS_RETENTION_MS_CONFIG),
             on_sampling_pause=self.load_monitor.pause_sampling,
             on_sampling_resume=self.load_monitor.resume_sampling,
             min_isr_pressure_fn=lambda: min_isr_pressure(
-                executor_metadata.cluster(), isr_cache))
+                executor_metadata.cluster(), isr_cache),
+            progress_check_interval_ms=cfg.get(
+                C.EXECUTION_PROGRESS_CHECK_INTERVAL_MS_CONFIG),
+            leader_movement_timeout_ms=cfg.get(C.LEADER_MOVEMENT_TIMEOUT_MS_CONFIG),
+            concurrency_adjuster_enabled=cfg.get(
+                C.EXECUTOR_CONCURRENCY_ADJUSTER_ENABLED_CONFIG),
+            concurrency_adjuster_interval_ms=cfg.get(
+                C.CONCURRENCY_ADJUSTER_INTERVAL_MS_CONFIG),
+            concurrency_adjuster_min_per_broker=cfg.get(
+                C.CONCURRENCY_ADJUSTER_MIN_PARTITION_MOVEMENTS_PER_BROKER_CONFIG),
+            concurrency_adjuster_max_per_broker=cfg.get(
+                C.CONCURRENCY_ADJUSTER_MAX_PARTITION_MOVEMENTS_PER_BROKER_CONFIG))
         from cruise_control_tpu.analyzer.balancing_constraint import BalancingConstraint
+        from cruise_control_tpu.monitor.load_monitor import \
+            ModelCompletenessRequirements
         self.cruise_control = CruiseControl(
             self.load_monitor, self.executor, self.admin,
             goals=cfg.get(C.DEFAULT_GOALS_CONFIG),
             hard_goals=cfg.get(C.HARD_GOALS_CONFIG),
             constraint=BalancingConstraint.from_config(cfg),
+            requirements=ModelCompletenessRequirements(
+                min_monitored_partitions_percentage=cfg.get(
+                    C.MIN_VALID_PARTITION_RATIO_CONFIG)),
             proposal_expiration_ms=cfg.get(C.PROPOSAL_EXPIRATION_MS_CONFIG),
             max_steps_per_goal=min(cfg.get(C.MAX_OPTIMIZER_STEPS_CONFIG), 4096),
-            max_candidates_per_step=cfg.get(C.MAX_CANDIDATES_PER_STEP_CONFIG))
+            max_candidates_per_step=cfg.get(C.MAX_CANDIDATES_PER_STEP_CONFIG),
+            balancedness_priority_weight=cfg.get(
+                C.GOAL_BALANCEDNESS_PRIORITY_WEIGHT_CONFIG),
+            balancedness_strictness_weight=cfg.get(
+                C.GOAL_BALANCEDNESS_STRICTNESS_WEIGHT_CONFIG),
+            supported_goals=cfg.get(C.GOALS_CONFIG),
+            intra_broker_goals=cfg.get(C.INTRA_BROKER_GOALS_CONFIG),
+            allow_capacity_estimation=cfg.get(C.ALLOW_CAPACITY_ESTIMATION_CONFIG),
+            excluded_topics_pattern=(
+                cfg.get(C.TOPICS_EXCLUDED_FROM_PARTITION_MOVEMENT_CONFIG) or None),
+            self_healing_exclude_recently_demoted=cfg.get(
+                C.SELF_HEALING_EXCLUDE_RECENTLY_DEMOTED_BROKERS_CONFIG),
+            self_healing_exclude_recently_removed=cfg.get(
+                C.SELF_HEALING_EXCLUDE_RECENTLY_REMOVED_BROKERS_CONFIG))
 
         provisioner = cfg.get_configured_instance(
             C.PROVISIONER_CLASS_CONFIG, Provisioner)
+        from cruise_control_tpu.detector.detectors import (
+            MetricAnomalyDetector, TopicAnomalyDetector)
+        from cruise_control_tpu.detector.notifier import AnomalyNotifier
+        # anomaly.notifier.class (AnomalyDetectorConfig) selects the notifier
+        # plugin; the default SelfHealingNotifier reads the broker-failure
+        # alert/self-heal thresholds through configure().
+        notifier = cfg.get_configured_instance(
+            C.ANOMALY_NOTIFIER_CLASS_CONFIG, AnomalyNotifier)
         self.detector_manager = AnomalyDetectorManager(
-            notifier=SelfHealingNotifier(),
+            notifier=notifier,
             facade=self.cruise_control,
             executor_busy=lambda: self.executor.has_ongoing_execution,
             history_size=cfg.get(C.NUM_CACHED_RECENT_ANOMALY_STATES_CONFIG))
@@ -168,11 +240,30 @@ class KafkaCruiseControlApp:
         self.detector_manager.register_detector(
             GoalViolationDetector(self.load_monitor,
                                   cfg.get(C.ANOMALY_DETECTION_GOALS_CONFIG),
-                                  provisioner=provisioner), interval)
+                                  provisioner=provisioner,
+                                  balancedness_priority_weight=cfg.get(
+                                      C.GOAL_BALANCEDNESS_PRIORITY_WEIGHT_CONFIG),
+                                  balancedness_strictness_weight=cfg.get(
+                                      C.GOAL_BALANCEDNESS_STRICTNESS_WEIGHT_CONFIG)),
+            interval)
         self.detector_manager.register_detector(
             BrokerFailureDetector(self.metadata_client), interval)
         self.detector_manager.register_detector(
             DiskFailureDetector(self.admin, self.metadata_client), interval)
+        # metric.anomaly.finder.class (slow-broker detection by default).
+        finders = cfg.get_configured_instances(
+            C.METRIC_ANOMALY_FINDER_CLASSES_CONFIG, object)
+        if finders:
+            self.detector_manager.register_detector(
+                MetricAnomalyDetector(self.load_monitor, finders), interval)
+        # topic.anomaly.finder.class + the target RF for self-healing.
+        topic_finders = cfg.get_configured_instances(
+            C.TOPIC_ANOMALY_FINDER_CLASSES_CONFIG, object)
+        if topic_finders:
+            self.detector_manager.register_detector(
+                TopicAnomalyDetector(self.metadata_client,
+                                     load_monitor=self.load_monitor,
+                                     finders=topic_finders), interval)
         if self._kafka_client is not None:
             from cruise_control_tpu.detector.detectors import MaintenanceEventDetector
             from cruise_control_tpu.kafka.maintenance import KafkaMaintenanceEventReader
@@ -182,14 +273,27 @@ class KafkaCruiseControlApp:
 
         security: SecurityProvider = SecurityProvider()
         if cfg.get(C.WEBSERVER_SECURITY_ENABLE_CONFIG):
-            creds_file = cfg.get(C.WEBSERVER_AUTH_CREDENTIALS_FILE_CONFIG)
-            security = BasicSecurityProvider(
-                _load_credentials(creds_file) if creds_file else {})
+            # webserver.security.provider (WebServerConfig) names the plugin;
+            # its configure() reads the credentials file / provider-specific
+            # keys from the merged config.
+            security = cfg.get_configured_instance(
+                C.WEBSERVER_SECURITY_PROVIDER_CONFIG, SecurityProvider)
+        from cruise_control_tpu.api.purgatory import Purgatory
+        from cruise_control_tpu.api.user_tasks import UserTaskManager
         self.api = CruiseControlApi(
             self.cruise_control, detector_manager=self.detector_manager,
             sampler=self.sampler,
             two_step_verification=cfg.get(C.TWO_STEP_VERIFICATION_ENABLED_CONFIG),
-            security=security)
+            security=security,
+            user_tasks=UserTaskManager(
+                completed_retention_ms=cfg.get(
+                    C.COMPLETED_USER_TASK_RETENTION_TIME_MS_CONFIG),
+                max_active_tasks=cfg.get(C.MAX_ACTIVE_USER_TASKS_CONFIG),
+                max_cached_completed=cfg.get(
+                    C.MAX_CACHED_COMPLETED_USER_TASKS_CONFIG)),
+            purgatory=Purgatory(
+                retention_ms=cfg.get(C.TWO_STEP_PURGATORY_RETENTION_TIME_MS_CONFIG),
+                max_requests=cfg.get(C.TWO_STEP_PURGATORY_MAX_REQUESTS_CONFIG)))
 
     # -- lifecycle (KafkaCruiseControl.startUp, :201-207) ---------------------
     def start(self) -> int:
@@ -225,8 +329,47 @@ class KafkaCruiseControlApp:
                     pass
                 self._stop.wait(detector_interval_s)
 
-        for name, fn in (("cc-sampling", sampling_loop),
-                         ("cc-anomaly-detector", detector_loop)):
+        # Background proposal precompute (GoalOptimizer.run proposal-precompute
+        # loop, GoalOptimizer.java:140-190): keeps the cache warm so
+        # GET /proposals is served from it; num.proposal.precompute.threads=0
+        # disables.  One thread per configured count (the optimizer itself
+        # batches on the accelerator, so extra threads only pipeline model
+        # builds).
+        precompute_flight = threading.Lock()
+
+        def precompute_loop():
+            wait_s = max(cfg.get(C.PROPOSAL_EXPIRATION_MS_CONFIG) / 1000.0, 1.0)
+            while not self._stop.is_set():
+                # Single-flight: the threads pipeline cache refreshes, they
+                # must not all rebuild the same model at once.
+                if precompute_flight.acquire(blocking=False):
+                    try:
+                        self.cruise_control.proposals()
+                    except Exception:  # noqa: BLE001 — not enough windows yet
+                        pass
+                    finally:
+                        precompute_flight.release()
+                self._stop.wait(wait_s)
+
+        # Sensor/state updater (LoadMonitor.java:177-179 sensor updater
+        # thread): refreshes the monitored-percentage cache at
+        # monitor.state.update.interval.ms so /metrics gauges stay fresh
+        # without an inbound request.
+        def state_updater_loop():
+            wait_s = cfg.get(C.MONITOR_STATE_UPDATE_INTERVAL_MS_CONFIG) / 1000.0
+            while not self._stop.is_set():
+                try:
+                    self.load_monitor.monitored_partitions_percentage()
+                except Exception:  # noqa: BLE001
+                    pass
+                self._stop.wait(wait_s)
+
+        loops = [("cc-sampling", sampling_loop),
+                 ("cc-anomaly-detector", detector_loop),
+                 ("cc-monitor-state-updater", state_updater_loop)]
+        loops += [(f"cc-proposal-precompute-{i}", precompute_loop)
+                  for i in range(cfg.get(C.NUM_PROPOSAL_PRECOMPUTE_THREADS_CONFIG))]
+        for name, fn in loops:
             t = threading.Thread(target=fn, daemon=True, name=name)
             t.start()
             self._threads.append(t)

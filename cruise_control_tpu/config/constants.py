@@ -126,8 +126,9 @@ def analyzer_config_def() -> ConfigDef:
              doc="Hard cap on replicas per broker (ReplicaCapacityGoal).", group="analyzer")
     d.define(PROPOSAL_EXPIRATION_MS_CONFIG, Type.LONG, 60000, Range.at_least(0), Importance.MEDIUM,
              doc="Precomputed proposals are invalidated after this long.", group="analyzer")
-    d.define(NUM_PROPOSAL_PRECOMPUTE_THREADS_CONFIG, Type.INT, 1, Range.at_least(1), Importance.LOW,
-             doc="Number of background proposal precompute threads.", group="analyzer")
+    d.define(NUM_PROPOSAL_PRECOMPUTE_THREADS_CONFIG, Type.INT, 1, Range.at_least(0), Importance.LOW,
+             doc="Number of background proposal precompute threads (0 disables).",
+             group="analyzer")
     d.define(MAX_CANDIDATES_PER_STEP_CONFIG, Type.INT, 16384, Range.at_least(1), Importance.MEDIUM,
              doc="Candidate balancing actions scored per batched optimizer step (TPU batch size).",
              group="analyzer")
@@ -328,6 +329,8 @@ SLOW_BROKER_PEER_METRIC_MARGIN_CONFIG = "slow.broker.peer.metric.margin"
 SELF_HEALING_EXCLUDE_RECENTLY_DEMOTED_BROKERS_CONFIG = "self.healing.exclude.recently.demoted.brokers"
 SELF_HEALING_EXCLUDE_RECENTLY_REMOVED_BROKERS_CONFIG = "self.healing.exclude.recently.removed.brokers"
 TOPIC_ANOMALY_FINDER_CLASSES_CONFIG = "topic.anomaly.finder.class"
+SELF_HEALING_PARTITION_SIZE_THRESHOLD_MB_CONFIG = \
+    "self.healing.partition.size.threshold.mb"
 SELF_HEALING_TARGET_TOPIC_REPLICATION_FACTOR_CONFIG = "self.healing.target.topic.replication.factor"
 PROVISIONER_CLASS_CONFIG = "provisioner.class"
 NUM_CACHED_RECENT_ANOMALY_STATES_CONFIG = "num.cached.recent.anomaly.states"
@@ -352,7 +355,7 @@ def anomaly_detector_config_def() -> ConfigDef:
              Importance.MEDIUM, doc="Self-heal after a broker has been down this long.",
              group="detector")
     d.define(METRIC_ANOMALY_FINDER_CLASSES_CONFIG, Type.LIST,
-             ["cruise_control_tpu.detector.slow_broker.SlowBrokerFinder"],
+             ["cruise_control_tpu.detector.detectors.SlowBrokerFinder"],
              importance=Importance.MEDIUM, doc="Metric anomaly finder plugins.", group="detector")
     d.define(SLOW_BROKER_DEMOTION_SCORE_CONFIG, Type.INT, 5, Range.at_least(1), Importance.LOW,
              doc="Slowness score at which a broker is demoted.", group="detector")
@@ -380,10 +383,15 @@ def anomaly_detector_config_def() -> ConfigDef:
              importance=Importance.LOW, doc="Exclude recently removed brokers from self-healing.",
              group="detector")
     d.define(TOPIC_ANOMALY_FINDER_CLASSES_CONFIG, Type.LIST,
-             ["cruise_control_tpu.detector.topic_anomaly.TopicReplicationFactorAnomalyFinder"],
+             ["cruise_control_tpu.detector.detectors.TopicReplicationFactorAnomalyFinder",
+              "cruise_control_tpu.detector.detectors.PartitionSizeAnomalyFinder"],
              importance=Importance.LOW, doc="Topic anomaly finder plugins.", group="detector")
     d.define(SELF_HEALING_TARGET_TOPIC_REPLICATION_FACTOR_CONFIG, Type.INT, 3, Range.at_least(1),
              Importance.LOW, doc="Desired topic replication factor.", group="detector")
+    d.define(SELF_HEALING_PARTITION_SIZE_THRESHOLD_MB_CONFIG, Type.DOUBLE, float("inf"),
+             importance=Importance.LOW,
+             doc="Partitions larger than this are reported as topic anomalies "
+                 "(PartitionSizeAnomalyFinder; inf disables).", group="detector")
     d.define(PROVISIONER_CLASS_CONFIG, Type.STRING,
              "cruise_control_tpu.detector.provisioner.NoopProvisioner",
              importance=Importance.LOW, doc="Provisioner (rightsizing) plugin.", group="detector")
@@ -422,7 +430,7 @@ def webserver_config_def() -> ConfigDef:
     d.define(WEBSERVER_SECURITY_ENABLE_CONFIG, Type.BOOLEAN, False, importance=Importance.MEDIUM,
              doc="Enable authn/authz.", group="webserver")
     d.define(WEBSERVER_SECURITY_PROVIDER_CONFIG, Type.STRING,
-             "cruise_control_tpu.api.security.BasicSecurityProvider",
+             "cruise_control_tpu.api.server.BasicSecurityProvider",
              importance=Importance.MEDIUM, doc="Security provider plugin.", group="webserver")
     d.define(WEBSERVER_AUTH_CREDENTIALS_FILE_CONFIG, Type.STRING, "", importance=Importance.MEDIUM,
              doc="Credentials file for basic auth.", group="webserver")

@@ -77,7 +77,7 @@ class BrokerFailures(Anomaly):
         if not self.failed_brokers:
             return False
         return context.remove_brokers(sorted(self.failed_brokers),
-                                      reason=self.reason())
+                                      reason=self.reason(), self_healing=True)
 
 
 @dataclasses.dataclass
@@ -94,7 +94,8 @@ class DiskFailures(Anomaly):
         return f"Disk failures detected: {self.failed_disks}"
 
     def fix(self, context) -> bool:
-        return context.fix_offline_replicas(reason=self.reason())
+        return context.fix_offline_replicas(reason=self.reason(),
+                                            self_healing=True)
 
 
 @dataclasses.dataclass
@@ -115,7 +116,8 @@ class GoalViolations(Anomaly):
     def fix(self, context) -> bool:
         if not self.fixable_goals:
             return False
-        return context.rebalance(goals=self.fixable_goals, reason=self.reason())
+        return context.rebalance(goals=self.fixable_goals, reason=self.reason(),
+                                 self_healing=True)
 
 
 @dataclasses.dataclass
@@ -223,7 +225,8 @@ class MaintenanceEvent(Anomaly):
         if t == MaintenancePlanType.DEMOTE_BROKER:
             return context.demote_brokers(list(self.brokers), reason=self.reason())
         if t == MaintenancePlanType.FIX_OFFLINE_REPLICAS:
-            return context.fix_offline_replicas(reason=self.reason())
+            return context.fix_offline_replicas(reason=self.reason(),
+                                            self_healing=True)
         if t == MaintenancePlanType.TOPIC_REPLICATION_FACTOR:
             return context.update_topic_replication_factor(self.topics_rf,
                                                            reason=self.reason())

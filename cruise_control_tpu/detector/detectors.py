@@ -52,7 +52,11 @@ from cruise_control_tpu.monitor.metricdef import KAFKA_METRIC_DEF
 class GoalViolationDetector:
     def __init__(self, load_monitor: LoadMonitor, detection_goals: Sequence[str],
                  constraint: Optional[BalancingConstraint] = None,
-                 provisioner=None):
+                 provisioner=None,
+                 balancedness_priority_weight: float = 1.1,
+                 balancedness_strictness_weight: float = 1.5):
+        from cruise_control_tpu.analyzer.balancedness import (
+            MAX_BALANCEDNESS_SCORE, balancedness_cost_by_goal)
         self._lm = load_monitor
         self._goals = list(detection_goals)
         self._constraint = constraint or BalancingConstraint.default()
@@ -63,15 +67,27 @@ class GoalViolationDetector:
         self.last_checked_generation: Optional[Tuple[int, int]] = None
         self.last_provision_response = None
         self.last_rightsize_result = None
+        # Rolling balancedness (GoalViolationDetector.java:63-64,106):
+        # refreshed on every detection pass; 100 until the first pass.
+        self._balancedness_costs = (
+            balancedness_cost_by_goal(goals_by_priority(self._goals),
+                                      balancedness_priority_weight,
+                                      balancedness_strictness_weight)
+            if self._goals else {})  # empty detection set = detector disabled
+        self.balancedness_score: float = MAX_BALANCEDNESS_SCORE
 
     def detect(self, now_ms: int) -> Optional[GoalViolations]:
+        from cruise_control_tpu.analyzer.balancedness import (
+            BALANCEDNESS_SCORE_WITH_OFFLINE_REPLICAS, balancedness_score)
         try:
             model = self._lm.cluster_model()
         except NotEnoughValidWindowsError:
             return None
         if bool(np.asarray(model.replica_offline_now()).any()):
             # Defer to broker/disk failure detectors (GoalViolationDetector
-            # skips when offline replicas exist, :160-237).
+            # skips when offline replicas exist, :160-237); the score is
+            # pinned to the offline sentinel meanwhile (:69,281).
+            self.balancedness_score = BALANCEDNESS_SCORE_WITH_OFFLINE_REPLICAS
             return None
         gen = self._lm.model_generation().as_tuple()
         self.last_checked_generation = gen
@@ -96,6 +112,8 @@ class GoalViolationDetector:
             else:
                 fixable.append(spec.name)
         self.last_provision_response = provision
+        self.balancedness_score = balancedness_score(
+            self._balancedness_costs, fixable + unfixable)
         if self._provisioner is not None and provision.status in (
                 ProvisionStatus.UNDER_PROVISIONED,
                 ProvisionStatus.OVER_PROVISIONED):
@@ -219,14 +237,46 @@ class SlowBrokerFinder:
     BYTES_METRIC = "LEADER_BYTES_IN"
 
     def __init__(self, history_percentile: float = 90.0, history_margin: float = 3.0,
-                 peer_margin: float = 3.0, demote_score: int = 5,
-                 removal_score: int = 10):
+                 peer_percentile: float = 50.0, peer_margin: float = 3.0,
+                 demote_score: int = 5, removal_score: int = 10,
+                 bytes_in_rate_detection_threshold: float = 0.0,
+                 log_flush_time_threshold_ms: float = 0.0):
         self._pct = history_percentile
         self._hist_margin = history_margin
+        # slow.broker.peer.metric.percentile.threshold: which percentile of
+        # the peer cluster's latest values anchors the peer comparison
+        # (50 = the reference's median default).
+        self._peer_pct = peer_percentile
         self._peer_margin = peer_margin
         self._demote = demote_score
         self._removal = removal_score
+        # Absolute floors (slow.broker.bytes.in.rate.detection.threshold /
+        # slow.broker.log.flush.time.threshold.ms): idle brokers (tiny
+        # bytes-in denominators) and sub-threshold flush times never become
+        # suspects regardless of relative excursions.
+        self._min_bytes_in = bytes_in_rate_detection_threshold
+        self._min_flush_ms = log_flush_time_threshold_ms
         self._scores: Dict[int, int] = {}
+
+    def configure(self, config: Dict[str, object]) -> None:
+        """Plugin-style init (metric.anomaly.finder.class): reads the eight
+        slow.broker.* threshold keys (AnomalyDetectorConfig.java)."""
+        from cruise_control_tpu.config import constants as C
+        key_attr = {
+            C.SLOW_BROKER_METRIC_HISTORY_PERCENTILE_THRESHOLD_CONFIG: "_pct",
+            C.SLOW_BROKER_METRIC_HISTORY_MARGIN_CONFIG: "_hist_margin",
+            C.SLOW_BROKER_PEER_METRIC_PERCENTILE_THRESHOLD_CONFIG: "_peer_pct",
+            C.SLOW_BROKER_PEER_METRIC_MARGIN_CONFIG: "_peer_margin",
+            C.SLOW_BROKER_BYTES_IN_RATE_DETECTION_THRESHOLD_CONFIG: "_min_bytes_in",
+            C.SLOW_BROKER_LOG_FLUSH_TIME_THRESHOLD_MS_CONFIG: "_min_flush_ms",
+        }
+        for key, attr in key_attr.items():
+            if key in config:
+                setattr(self, attr, float(config[key]))
+        if C.SLOW_BROKER_DEMOTION_SCORE_CONFIG in config:
+            self._demote = int(config[C.SLOW_BROKER_DEMOTION_SCORE_CONFIG])
+        if C.SLOW_BROKER_DECOMMISSION_SCORE_CONFIG in config:
+            self._removal = int(config[C.SLOW_BROKER_DECOMMISSION_SCORE_CONFIG])
 
     def _suspects(self, res, mid: int, bytes_mid: int) -> Set[int]:
         vals = res.values[:, :, mid]
@@ -237,19 +287,22 @@ class SlowBrokerFinder:
         for row in range(vals.shape[0]):
             if res.window_valid[row, -1]:
                 latest_all.append(vals[row, -1])
-        peer_median = np.median(latest_all) if latest_all else 0.0
+        peer_anchor = (np.percentile(latest_all, self._peer_pct)
+                       if latest_all else 0.0)
         for row, broker in enumerate(res.entities):
             if not res.window_valid[row, -1] or vals.shape[1] < 3:
                 continue
             hist_ok = res.window_valid[row, :-1]
             if not hist_ok.any():
                 continue
+            raw_now, norm_now = vals[row, -1], norm[row, -1]
+            if bts[row, -1] < self._min_bytes_in or raw_now < self._min_flush_ms:
+                continue
             raw_hist = np.percentile(vals[row, :-1][hist_ok], self._pct)
             norm_hist = np.percentile(norm[row, :-1][hist_ok], self._pct)
-            raw_now, norm_now = vals[row, -1], norm[row, -1]
             own_slow = raw_now > raw_hist * self._hist_margin and \
                 norm_now > norm_hist * self._hist_margin
-            peer_slow = peer_median > 0 and raw_now > peer_median * self._peer_margin
+            peer_slow = peer_anchor > 0 and raw_now > peer_anchor * self._peer_margin
             if own_slow and peer_slow:
                 suspects.add(broker)
         return suspects
@@ -287,40 +340,107 @@ class SlowBrokerFinder:
         return None
 
 
-class TopicAnomalyDetector:
-    def __init__(self, metadata_client, desired_rf: int = 3,
-                 excluded_topics: Sequence[str] = (),
-                 partition_size_threshold_mb: float = float("inf"),
-                 load_monitor: Optional[LoadMonitor] = None):
-        self._md = metadata_client
-        self._rf = desired_rf
-        self._excluded = set(excluded_topics)
-        self._size_threshold = partition_size_threshold_mb
+class MetricAnomalyDetector:
+    """Runs pluggable metric-anomaly finders over the broker metric history
+    (detector/MetricAnomalyDetector.java:28; finder classes from
+    metric.anomaly.finder.class).  A finder is anything with
+    ``detect(broker_agg, now_ms) -> Anomaly | list[Anomaly] | None``
+    (SlowBrokerFinder is the default, as in the reference)."""
+
+    def __init__(self, load_monitor: LoadMonitor, finders: Sequence[object]):
         self._lm = load_monitor
+        self.finders = list(finders)
 
     def detect(self, now_ms: int) -> List[Anomaly]:
         out: List[Anomaly] = []
-        cluster = self._md.cluster()
+        for finder in self.finders:
+            found = finder.detect(self._lm.broker_aggregator, now_ms)
+            if found is None:
+                continue
+            out.extend(found if isinstance(found, list) else [found])
+        return out
+
+
+class TopicReplicationFactorAnomalyFinder:
+    """detector/TopicReplicationFactorAnomalyFinder.java: topics whose RF
+    differs from the desired RF (self.healing.target.topic.replication.factor)."""
+
+    def __init__(self, desired_rf: int = 3):
+        self.desired_rf = desired_rf
+
+    def configure(self, config: Dict[str, object]) -> None:
+        from cruise_control_tpu.config import constants as C
+        if C.SELF_HEALING_TARGET_TOPIC_REPLICATION_FACTOR_CONFIG in config:
+            self.desired_rf = int(
+                config[C.SELF_HEALING_TARGET_TOPIC_REPLICATION_FACTOR_CONFIG])
+
+    def find(self, cluster, load_monitor, excluded: Set[str],
+             now_ms: int) -> List[Anomaly]:
         bad: Dict[str, int] = {}
         for p in cluster.partitions:
-            if p.topic in self._excluded:
+            if p.topic in excluded:
                 continue
-            if len(p.replicas) != self._rf:
+            if len(p.replicas) != self.desired_rf:
                 bad[p.topic] = len(p.replicas)
-        if bad:
-            out.append(TopicReplicationFactorAnomaly(
-                detection_time_ms=now_ms, bad_topics=bad, desired_rf=self._rf))
-        if self._lm is not None and np.isfinite(self._size_threshold):
-            agg = self._lm.partition_aggregator.aggregate()
-            mid = KAFKA_METRIC_DEF.metric_info("DISK_USAGE").metric_id
-            oversized = {}
-            for row, tp in enumerate(agg.entities):
-                if agg.entity_valid[row] and agg.collapsed[row, mid] > self._size_threshold:
-                    oversized[f"{tp[0]}-{tp[1]}"] = float(agg.collapsed[row, mid])
-            if oversized:
-                out.append(TopicPartitionSizeAnomaly(
-                    detection_time_ms=now_ms, oversized=oversized,
-                    size_threshold_mb=self._size_threshold))
+        if not bad:
+            return []
+        return [TopicReplicationFactorAnomaly(
+            detection_time_ms=now_ms, bad_topics=bad, desired_rf=self.desired_rf)]
+
+
+class PartitionSizeAnomalyFinder:
+    """detector/PartitionSizeAnomalyFinder: partitions whose disk footprint
+    exceeds a threshold."""
+
+    def __init__(self, size_threshold_mb: float = float("inf")):
+        self.size_threshold_mb = size_threshold_mb
+
+    def configure(self, config: Dict[str, object]) -> None:
+        from cruise_control_tpu.config import constants as C
+        if C.SELF_HEALING_PARTITION_SIZE_THRESHOLD_MB_CONFIG in config:
+            self.size_threshold_mb = float(
+                config[C.SELF_HEALING_PARTITION_SIZE_THRESHOLD_MB_CONFIG])
+
+    def find(self, cluster, load_monitor, excluded: Set[str],
+             now_ms: int) -> List[Anomaly]:
+        if load_monitor is None or not np.isfinite(self.size_threshold_mb):
+            return []
+        agg = load_monitor.partition_aggregator.aggregate()
+        mid = KAFKA_METRIC_DEF.metric_info("DISK_USAGE").metric_id
+        oversized = {}
+        for row, tp in enumerate(agg.entities):
+            if tp[0] in excluded:
+                continue
+            if agg.entity_valid[row] and agg.collapsed[row, mid] > self.size_threshold_mb:
+                oversized[f"{tp[0]}-{tp[1]}"] = float(agg.collapsed[row, mid])
+        if not oversized:
+            return []
+        return [TopicPartitionSizeAnomaly(
+            detection_time_ms=now_ms, oversized=oversized,
+            size_threshold_mb=self.size_threshold_mb)]
+
+
+class TopicAnomalyDetector:
+    """Runs pluggable topic-anomaly finders (TopicAnomalyDetector.java:24;
+    classes from topic.anomaly.finder.class) against the metadata view."""
+
+    def __init__(self, metadata_client, desired_rf: int = 3,
+                 excluded_topics: Sequence[str] = (),
+                 partition_size_threshold_mb: float = float("inf"),
+                 load_monitor: Optional[LoadMonitor] = None,
+                 finders: Optional[Sequence[object]] = None):
+        self._md = metadata_client
+        self._excluded = set(excluded_topics)
+        self._lm = load_monitor
+        self.finders = (list(finders) if finders is not None else
+                        [TopicReplicationFactorAnomalyFinder(desired_rf),
+                         PartitionSizeAnomalyFinder(partition_size_threshold_mb)])
+
+    def detect(self, now_ms: int) -> List[Anomaly]:
+        cluster = self._md.cluster()
+        out: List[Anomaly] = []
+        for finder in self.finders:
+            out.extend(finder.find(cluster, self._lm, self._excluded, now_ms))
         return out
 
 

@@ -59,8 +59,14 @@ class AnomalyDetectorState:
     def recent(self, anomaly_type: AnomalyType) -> List[AnomalyState]:
         return list(self._history[anomaly_type])
 
-    def to_dict(self, notifier: AnomalyNotifier) -> Dict[str, object]:
+    def to_dict(self, notifier: AnomalyNotifier,
+                balancedness_score: Optional[float] = None) -> Dict[str, object]:
         return {
+            # Quantifies how well the load distribution satisfies the
+            # detection goals (AnomalyDetectorState.java:384); absent until a
+            # GoalViolationDetector is registered.
+            **({"balancednessScore": balancedness_score}
+               if balancedness_score is not None else {}),
             "selfHealingEnabled": {t.name: v for t, v in
                                    notifier.self_healing_enabled().items()},
             "recentAnomalies": {
@@ -98,6 +104,19 @@ class AnomalyDetectorManager:
     @property
     def notifier(self) -> AnomalyNotifier:
         return self._notifier
+
+    def balancedness_score(self) -> Optional[float]:
+        """The goal-violation detector's rolling balancedness score
+        (AnomalyDetectorManager.java:180 registers it as a gauge)."""
+        for detector, _, _ in self._detectors:
+            score = getattr(detector, "balancedness_score", None)
+            if score is not None:
+                return float(score)
+        return None
+
+    def state_dict(self) -> Dict[str, object]:
+        """The /state AnomalyDetectorState payload."""
+        return self.state.to_dict(self._notifier, self.balancedness_score())
 
     def register_detector(self, detector, interval_ms: int) -> None:
         """detector.detect(now_ms) -> Anomaly | list[Anomaly] | None."""

@@ -71,6 +71,19 @@ class SelfHealingNotifier(AnomalyNotifier):
         self._alert_hook = alert_hook
         self.alerts: List[Anomaly] = []
 
+    def configure(self, config: Dict[str, object]) -> None:
+        """Plugin-style init (anomaly.notifier.class): reads the
+        broker-failure alert/self-heal thresholds and the master
+        self-healing switch from the merged config."""
+        from cruise_control_tpu.config import constants as C
+        if C.BROKER_FAILURE_ALERT_THRESHOLD_MS_CONFIG in config:
+            self._alert_ms = int(config[C.BROKER_FAILURE_ALERT_THRESHOLD_MS_CONFIG])
+        if C.BROKER_FAILURE_SELF_HEALING_THRESHOLD_MS_CONFIG in config:
+            self._heal_ms = int(
+                config[C.BROKER_FAILURE_SELF_HEALING_THRESHOLD_MS_CONFIG])
+        if config.get(C.SELF_HEALING_ENABLED_CONFIG):
+            self._enabled = dict.fromkeys(AnomalyType, True)
+
     def self_healing_enabled(self) -> Dict[AnomalyType, bool]:
         return dict(self._enabled)
 

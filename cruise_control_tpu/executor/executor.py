@@ -74,12 +74,26 @@ class ConcurrencyAdjuster:
     REQUEST_QUEUE_SIZE_CAP = 1000.0
     MIN_IDLE_RATIO = 0.3
 
-    def __init__(self, base: ConcurrencyLimits):
+    def __init__(self, base: ConcurrencyLimits,
+                 min_per_broker: int = 1,
+                 max_per_broker: Optional[int] = None,
+                 interval_ms: int = 0):
+        # concurrency.adjuster.{min,max}.partition.movements.per.broker +
+        # .interval.ms (ExecutorConfig): the floor/ceiling of auto-scaling
+        # and how often it re-evaluates (0 = every poll).
         self._base = base
+        self._min = max(1, min_per_broker)
+        self._max = max_per_broker or base.inter_broker_per_broker
+        self._interval_ms = interval_ms
+        self._last_adjust_ms = 0.0
 
     def adjust(self, limits: ConcurrencyLimits,
                broker_metrics: Dict[int, Dict[str, float]],
                has_min_isr_pressure: bool = False) -> ConcurrencyLimits:
+        now_ms = time.monotonic() * 1000
+        if self._interval_ms and now_ms - self._last_adjust_ms < self._interval_ms:
+            return limits
+        self._last_adjust_ms = now_ms
         stressed = has_min_isr_pressure
         for m in broker_metrics.values():
             if m.get("BROKER_REQUEST_QUEUE_SIZE", 0.0) > self.REQUEST_QUEUE_SIZE_CAP:
@@ -88,9 +102,9 @@ class ConcurrencyAdjuster:
                 stressed = True
         cur = limits.inter_broker_per_broker
         if stressed:
-            new = max(1, cur // 2)
+            new = max(self._min, cur // 2)
         else:
-            new = min(self._base.inter_broker_per_broker, cur * 2)
+            new = min(self._max, self._base.inter_broker_per_broker, cur * 2)
         return dataclasses.replace(limits, inter_broker_per_broker=new)
 
 
@@ -101,10 +115,17 @@ class Executor:
                  strategy: Optional[ReplicaMovementStrategy] = None,
                  throttle_rate_bytes_per_sec: Optional[int] = None,
                  removed_broker_retention_ms: int = 12 * 3600 * 1000,
+                 demoted_broker_retention_ms: Optional[int] = None,
                  on_sampling_pause: Optional[Callable[[str], None]] = None,
                  on_sampling_resume: Optional[Callable[[], None]] = None,
                  logdir_by_disk: Optional[Dict[int, str]] = None,
-                 min_isr_pressure_fn: Optional[Callable[[], bool]] = None):
+                 min_isr_pressure_fn: Optional[Callable[[], bool]] = None,
+                 progress_check_interval_ms: int = 0,
+                 leader_movement_timeout_ms: int = 180_000,
+                 concurrency_adjuster_enabled: bool = True,
+                 concurrency_adjuster_interval_ms: int = 0,
+                 concurrency_adjuster_min_per_broker: int = 1,
+                 concurrency_adjuster_max_per_broker: Optional[int] = None):
         self._admin = admin
         self._metadata = metadata_client
         self._limits = limits or ConcurrencyLimits()
@@ -116,14 +137,27 @@ class Executor:
         self._force_stop = False
         self._reserved_for_proposals = False
         self._retention_ms = removed_broker_retention_ms
+        # demoted.broker.retention.time.ms may differ from removed
+        # (ExecutorConfig: two distinct retention knobs).
+        self._demoted_retention_ms = (demoted_broker_retention_ms
+                                      if demoted_broker_retention_ms is not None
+                                      else removed_broker_retention_ms)
         self._recently_removed: Dict[int, int] = {}   # broker → time_ms
         self._recently_demoted: Dict[int, int] = {}
         self._on_pause = on_sampling_pause
         self._on_resume = on_sampling_resume
         self._logdir_by_disk = logdir_by_disk or {}
         self._min_isr_pressure_fn = min_isr_pressure_fn or (lambda: False)
+        # execution.progress.check.interval.ms / leader.movement.timeout.ms:
+        # the wait-loop cadence and the leadership phase's wall-clock bound.
+        self._progress_check_interval_s = progress_check_interval_ms / 1000.0
+        self._leader_movement_timeout_ms = leader_movement_timeout_ms
+        self._adjuster_enabled = concurrency_adjuster_enabled
+        self._adjuster_args = (concurrency_adjuster_min_per_broker,
+                               concurrency_adjuster_max_per_broker,
+                               concurrency_adjuster_interval_ms)
         self._task_manager: Optional[ExecutionTaskManager] = None
-        self._adjuster = ConcurrencyAdjuster(self._limits)
+        self._adjuster = ConcurrencyAdjuster(self._limits, *self._adjuster_args)
         # Sensor registrations (Executor.registerGaugeSensors,
         # Executor.java:271; Sensors.md execution gauges).
         from cruise_control_tpu.common.sensors import SENSORS
@@ -169,7 +203,7 @@ class Executor:
         cap, not the stale one), and any live execution's task manager."""
         with self._lock:
             self._limits = limits
-            self._adjuster = ConcurrencyAdjuster(limits)
+            self._adjuster = ConcurrencyAdjuster(limits, *self._adjuster_args)
             if self._task_manager is not None:
                 self._task_manager.set_limits(limits)
 
@@ -213,8 +247,10 @@ class Executor:
             self._admin.cancel_reassignments()
 
     # -- broker history ------------------------------------------------------
-    def _gc_history(self, history: Dict[int, int], now_ms: int) -> None:
-        expired = [b for b, t in history.items() if now_ms - t > self._retention_ms]
+    def _gc_history(self, history: Dict[int, int], now_ms: int,
+                    retention_ms: Optional[int] = None) -> None:
+        keep_ms = retention_ms if retention_ms is not None else self._retention_ms
+        expired = [b for b, t in history.items() if now_ms - t > keep_ms]
         for b in expired:
             del history[b]
 
@@ -246,7 +282,8 @@ class Executor:
     def recently_demoted_brokers(self, now_ms: Optional[int] = None) -> Set[int]:
         now = now_ms if now_ms is not None else int(time.time() * 1000)
         with self._lock:
-            self._gc_history(self._recently_demoted, now)
+            self._gc_history(self._recently_demoted, now,
+                             self._demoted_retention_ms)
             return set(self._recently_demoted)
 
     # -- main entry ----------------------------------------------------------
@@ -254,7 +291,7 @@ class Executor:
                           partition_names: Sequence[Tp],
                           context: Optional[StrategyContext] = None,
                           max_polls: int = 10_000,
-                          poll_interval_s: float = 0.0,
+                          poll_interval_s: Optional[float] = None,
                           concurrency_adjust_metrics: Optional[
                               Callable[[], Dict[int, Dict[str, float]]]] = None
                           ) -> ExecutionResult:
@@ -262,8 +299,11 @@ class Executor:
 
         ``partition_names[p.partition]`` maps a proposal's dense partition id
         to its (topic, partition) — the naming seam between the tensor world
-        and the cluster protocol.
+        and the cluster protocol.  ``poll_interval_s=None`` uses the
+        configured execution.progress.check.interval.ms cadence.
         """
+        if poll_interval_s is None:
+            poll_interval_s = self._progress_check_interval_s
         with self._lock:
             if self.has_ongoing_execution:
                 raise OngoingExecutionError("an execution is already in progress")
@@ -388,7 +428,7 @@ class Executor:
                         self._admin.cancel_reassignments([tp])
                         del submitted[t.execution_id]
             polls += 1
-            if metrics_fn is not None:
+            if metrics_fn is not None and self._adjuster_enabled:
                 tm.set_limits(self._adjuster.adjust(
                     tm.limits, metrics_fn(),
                     has_min_isr_pressure=self._min_isr_pressure_fn()))
@@ -436,12 +476,15 @@ class Executor:
                 t.in_progress()
             self._admin.alter_partition_reassignments(reqs)
             polls = 0
+            deadline = time.monotonic() + self._leader_movement_timeout_ms / 1000.0
             while self._admin.ongoing_reassignments() and polls < max_polls \
-                    and not self._force_stop:
+                    and not self._force_stop and time.monotonic() < deadline:
                 polls += 1
                 if poll_interval_s:
                     time.sleep(poll_interval_s)
-            timed_out = polls >= max_polls or self._force_stop
+            timed_out = (polls >= max_polls or self._force_stop
+                         or (self._admin.ongoing_reassignments()
+                             and time.monotonic() >= deadline))
             if not timed_out:
                 self._admin.elect_leaders([partition_names[t.proposal.partition]
                                            for t in tasks])

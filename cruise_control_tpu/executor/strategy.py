@@ -121,14 +121,18 @@ STRATEGIES = {
 }
 
 
+_BY_CLASS_NAME = {type(s).__name__: s for s in STRATEGIES.values()}
+
+
 def resolve_strategy(names: Sequence[str]) -> ReplicaMovementStrategy:
     """Build a chained strategy from config names (ExecutorConfig
-    default.replica.movement.strategies analogue)."""
+    default.replica.movement.strategies analogue).  Accepts short names
+    ("prioritize-large"), class names, or fully-qualified class paths."""
     if not names:
         return BaseReplicaMovementStrategy()
     out: Optional[ReplicaMovementStrategy] = None
     for n in names:
-        s = STRATEGIES.get(n)
+        s = STRATEGIES.get(n) or _BY_CLASS_NAME.get(n.rsplit(".", 1)[-1])
         if s is None:
             raise ValueError(f"unknown replica movement strategy {n!r}")
         out = s if out is None else out.chain(s)

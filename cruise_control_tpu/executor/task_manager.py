@@ -35,6 +35,10 @@ class ConcurrencyLimits:
     intra_broker_per_broker: int = 2
     leadership_cluster: int = 1000
     max_cluster_movements: int = 1250
+    # max.num.cluster.partition.movements: cluster-wide cap on in-flight
+    # inter-broker partition movements specifically (max.num.cluster.movements
+    # caps ALL in-flight work, leadership included).
+    max_cluster_partition_movements: int = 1250
 
     def for_type(self, t: ConcurrencyType) -> int:
         if t == ConcurrencyType.INTER_BROKER_REPLICA:
@@ -68,8 +72,10 @@ class ExecutionTaskManager:
         cap = self._limits.inter_broker_per_broker
         out: List[ExecutionTask] = []
         total_active = len(self._inflight)
+        partition_cap = min(self._limits.max_cluster_movements,
+                            self._limits.max_cluster_partition_movements)
         for task in self._plan.inter_broker_tasks:
-            if total_active + len(out) >= self._limits.max_cluster_movements:
+            if total_active + len(out) >= partition_cap:
                 break
             if task.state != TaskState.PENDING or task.execution_id in self._inflight:
                 continue
@@ -96,7 +102,8 @@ class ExecutionTaskManager:
         return out
 
     def next_leadership_tasks(self) -> List[ExecutionTask]:
-        cap = self._limits.leadership_cluster
+        cap = min(self._limits.leadership_cluster,
+                  max(0, self._limits.max_cluster_movements - len(self._inflight)))
         out: List[ExecutionTask] = []
         for task in self._plan.leadership_tasks:
             if len(out) >= cap:

@@ -91,6 +91,8 @@ class LoadMonitor:
                  broker_window_ms: int = 300_000,
                  min_samples_per_window: int = 1,
                  max_allowed_extrapolations: int = 5,
+                 min_samples_per_broker_window: Optional[int] = None,
+                 max_allowed_broker_extrapolations: Optional[int] = None,
                  follower_cpu_ratio: float = DEFAULT_CPU_WEIGHT_OF_FOLLOWER):
         self._metadata = metadata_client
         self._capacity = capacity_resolver or StaticCapacityResolver()
@@ -99,9 +101,17 @@ class LoadMonitor:
         self.partition_aggregator = MetricSampleAggregator(
             num_partition_windows, partition_window_ms, min_samples_per_window,
             max_allowed_extrapolations)
+        # The broker aggregator has its own validity knobs
+        # (min.samples.per.broker.metrics.window /
+        # max.allowed.extrapolations.per.broker, MonitorConfig).
         self.broker_aggregator = MetricSampleAggregator(
-            num_broker_windows, broker_window_ms, min_samples_per_window,
-            max_allowed_extrapolations)
+            num_broker_windows, broker_window_ms,
+            (min_samples_per_broker_window
+             if min_samples_per_broker_window is not None
+             else min_samples_per_window),
+            (max_allowed_broker_extrapolations
+             if max_allowed_broker_extrapolations is not None
+             else max_allowed_extrapolations))
         self._lock = threading.RLock()
         self._state = LoadMonitorState.NOT_STARTED
         self._sampling_paused = False

@@ -1,0 +1,123 @@
+"""Balancedness scoring (KafkaCruiseControlUtils.balancednessCostByGoal:
+weights by priority position and hard/soft strictness, normalized to 100;
+surfaced in OptimizerRun / the rebalance response and in the anomaly
+detector's /state payload via GoalViolationDetector)."""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.balancedness import (
+    BALANCEDNESS_SCORE_WITH_OFFLINE_REPLICAS, MAX_BALANCEDNESS_SCORE,
+    balancedness_cost_by_goal, balancedness_score)
+from cruise_control_tpu.analyzer.goals.specs import goals_by_priority
+
+
+def test_costs_sum_to_max_and_order_by_priority():
+    specs = goals_by_priority(["RackAwareGoal", "ReplicaCapacityGoal",
+                               "ReplicaDistributionGoal"])
+    costs = balancedness_cost_by_goal(specs, 1.1, 1.5)
+    assert sum(costs.values()) == pytest.approx(MAX_BALANCEDNESS_SCORE)
+    # Higher priority goal costs more; hard goals cost strictness× more
+    # than a soft goal at the same priority would.
+    assert costs["RackAwareGoal"] > costs["ReplicaCapacityGoal"]
+    assert costs["ReplicaCapacityGoal"] > costs["ReplicaDistributionGoal"]
+
+
+def test_strictness_weight_separates_hard_from_soft():
+    specs = goals_by_priority(["ReplicaCapacityGoal", "ReplicaDistributionGoal"])
+    eq = balancedness_cost_by_goal(specs, priority_weight=1.0,
+                                   strictness_weight=1.0)
+    assert eq["ReplicaCapacityGoal"] == pytest.approx(eq["ReplicaDistributionGoal"])
+    strict = balancedness_cost_by_goal(specs, priority_weight=1.0,
+                                       strictness_weight=3.0)
+    # hard ReplicaCapacityGoal gets 3x the soft goal's cost.
+    assert strict["ReplicaCapacityGoal"] == pytest.approx(
+        3 * strict["ReplicaDistributionGoal"])
+
+
+def test_score_subtracts_violated_costs():
+    specs = goals_by_priority(["RackAwareGoal", "ReplicaDistributionGoal"])
+    costs = balancedness_cost_by_goal(specs)
+    assert balancedness_score(costs, []) == MAX_BALANCEDNESS_SCORE
+    assert balancedness_score(costs, ["RackAwareGoal"]) == pytest.approx(
+        MAX_BALANCEDNESS_SCORE - costs["RackAwareGoal"])
+    assert balancedness_score(
+        costs, ["RackAwareGoal", "ReplicaDistributionGoal"]) == pytest.approx(0.0)
+
+
+def test_invalid_weights_rejected():
+    specs = goals_by_priority(["RackAwareGoal"])
+    with pytest.raises(ValueError):
+        balancedness_cost_by_goal(specs, priority_weight=0.0)
+    with pytest.raises(ValueError):
+        balancedness_cost_by_goal([], 1.1, 1.5)
+
+
+def test_optimizer_run_reports_balancedness():
+    from cruise_control_tpu.analyzer import optimizer as opt
+    from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster
+
+    model = generate_cluster(ClusterSpec(num_brokers=4, num_racks=2,
+                                         num_topics=3,
+                                         mean_partitions_per_topic=8.0,
+                                         replication_factor=2, seed=7))
+    run = opt.optimize(model, ["ReplicaDistributionGoal"],
+                       raise_on_hard_failure=False)
+    # A freshly generated skewed cluster violates the distribution goal
+    # before optimization and satisfies it after.
+    if run.violated_goals_before:
+        assert run.balancedness_before < MAX_BALANCEDNESS_SCORE
+    if not run.violated_goals_after:
+        assert run.balancedness_after == pytest.approx(MAX_BALANCEDNESS_SCORE)
+    assert run.balancedness_after >= run.balancedness_before
+
+
+def test_goal_violation_detector_refreshes_score(monkeypatch):
+    """The detector's rolling score drops when a goal is violated and is
+    pinned to -1 while offline replicas exist (GoalViolationDetector.java:
+    refreshBalancednessScore / setBalancednessWithOfflineReplicas)."""
+    from cruise_control_tpu.detector.detectors import GoalViolationDetector
+    from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster
+
+    model = generate_cluster(ClusterSpec(num_brokers=4, num_racks=2,
+                                         num_topics=3,
+                                         mean_partitions_per_topic=8.0,
+                                         replication_factor=2, seed=7))
+
+    class FakeLM:
+        def cluster_model(self, *a, **k):
+            return model
+
+        def model_generation(self):
+            class G:
+                def as_tuple(self):
+                    return (1, 1)
+            return G()
+
+    det = GoalViolationDetector(FakeLM(), ["ReplicaDistributionGoal"])
+    assert det.balancedness_score == MAX_BALANCEDNESS_SCORE
+    anomaly = det.detect(now_ms=1000)
+    if anomaly is not None:  # skewed cluster ⇒ violation ⇒ score drops
+        assert det.balancedness_score < MAX_BALANCEDNESS_SCORE
+    else:
+        assert det.balancedness_score == MAX_BALANCEDNESS_SCORE
+
+    # Offline replicas pin the sentinel score.
+    monkeypatch.setattr(type(model), "replica_offline_now",
+                        lambda self: np.array([True]), raising=False)
+    assert det.detect(now_ms=2000) is None
+    assert det.balancedness_score == BALANCEDNESS_SCORE_WITH_OFFLINE_REPLICAS
+
+
+def test_manager_state_surfaces_balancedness():
+    from cruise_control_tpu.detector.manager import AnomalyDetectorManager
+
+    class FakeDetector:
+        balancedness_score = 87.5
+
+        def detect(self, now_ms):
+            return None
+
+    mgr = AnomalyDetectorManager()
+    mgr.register_detector(FakeDetector(), 1000)
+    assert mgr.state_dict()["balancednessScore"] == 87.5
