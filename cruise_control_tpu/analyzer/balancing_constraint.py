@@ -40,7 +40,7 @@ class BalancingConstraint:
     fast_mode_per_broker_move_timeout_ms: int = 500
     # Max actions one broker participates in per batched optimizer step
     # (moves.per.step; select_batched's rounds × subround lanes).
-    moves_per_broker_step: int = 48
+    moves_per_broker_step: int = 128
     # MinTopicLeadersPerBrokerGoal (config-static designated-topic ids +
     # required leaders per broker; reference: topics.with.min.leaders.per.broker).
     min_topic_leaders_per_broker: int = 1

@@ -706,12 +706,38 @@ def source_replica_relevance(spec: GoalSpec, model: TensorClusterModel, arrays: 
     elif kind == "topic_replica_distribution":
         lower_t, upper_t = _topic_limits(model, arrays, constraint)
         tbc = model.topic_broker_replica_counts().astype(jnp.float32)
-        c = tbc[model.replica_topic, model.replica_broker]
-        relevant = c > upper_t[model.replica_topic]
-        rank = _within_broker_rank(model, jnp.where(relevant, c, -_BIG))
+        t, b = model.replica_topic, model.replica_broker
+        c = tbc[t, b]
+        over = c > upper_t[t]
+        # Donor sourcing: a topic with an under-filled pair must be able to
+        # move replicas out of its fullest pairs even when none is over the
+        # upper band — otherwise lower-band violations can never heal
+        # (the reference's rebalanceByMovingLoadIn pulls from any eligible
+        # broker, ResourceDistributionGoal.java:446-535).
+        under_exists = ((tbc < lower_t[:, None]) &
+                        arrays.alive[None, :]).any(axis=1)
+        avg_t = _topic_avg(model, arrays)
+        # Strictly-above-average pairs donate (ceil would collapse onto the
+        # upper band for small topics, blocking the heal entirely).
+        donor = under_exists[t] & (c > avg_t[t])
+        relevant = over | donor
+        # Rank within the (topic, broker) PAIR, not the broker: a broker
+        # with many violating topics must surface one source per topic per
+        # step, not its single worst topic (this was 90 of the mid rung's
+        # 154 steps).  Scaling the rank by the pair's overage allocates
+        # top-S slots PROPORTIONAL to how much each pair must shed, so one
+        # step can drain a hot pair to its band instead of 1-2 replicas per
+        # step per pair.
+        pair = t * model.num_brokers + b
+        rank = _within_group_rank(pair, jnp.where(relevant, c, -_BIG))
+        overage = jnp.where(over, c - upper_t[t],
+                            jnp.maximum(c - avg_t[t], 1.0))
         pnorm = pressure / jnp.maximum(jnp.abs(pressure).max(), 1e-9)
         base = jnp.where(relevant,
-                         -rank.astype(jnp.float32) + 0.5 * pnorm, -_BIG)
+                         -(rank.astype(jnp.float32) + 1.0)
+                         / jnp.maximum(overage, 1.0)
+                         + 0.25 * over + 0.5 * pnorm,
+                         -_BIG)
     else:
         relevant = pressure > 0
         if kind in ("leader_replica_distribution", "leader_bytes_in"):
@@ -737,9 +763,15 @@ def source_replica_relevance(spec: GoalSpec, model: TensorClusterModel, arrays: 
 def _within_broker_rank(model: TensorClusterModel, key_desc: Array) -> Array:
     """i32[R] — each replica's position among its broker's replicas when
     ordered by descending ``key_desc`` (0 = broker's best)."""
-    b = model.replica_broker
+    return _within_group_rank(model.replica_broker, key_desc)
+
+
+def _within_group_rank(group: Array, key_desc: Array) -> Array:
+    """i32[N] — each row's position among its group's rows when ordered by
+    descending ``key_desc`` (0 = group's best)."""
+    b = group
     r = b.shape[0]
-    order = jnp.lexsort((-key_desc, b))  # broker-major, key-desc within
+    order = jnp.lexsort((-key_desc, b))  # group-major, key-desc within
     b_sorted = b[order]
     idx = jnp.arange(r, dtype=jnp.int32)
     is_start = jnp.concatenate([jnp.ones((1,), bool),

@@ -54,6 +54,20 @@ from cruise_control_tpu.model.tensor_model import TensorClusterModel
 
 _MIN_SCORE = 1e-9  # strictly-positive improvement required (greedy accept)
 
+# Lanes per select_batched round; rounds = ceil(moves_per_broker_step / this).
+# Serial rounds dominate per-step cost (each is a long chain of small ops at
+# the op-launch floor), so prefer wide lanes over many rounds: measured at
+# the 50-broker rung, 1 round of 48 lanes is 3.8x cheaper per step than 6
+# rounds of 8 AND reaches the fixpoint in fewer steps (the budget-repair
+# passes run once instead of six times).  128 lanes lets hot brokers drain
+# at full band-budget speed.
+SUBROUNDS = 128
+
+# Perf-debug switches (tools/profiling only; never set in production paths).
+_DBG_TRIVIAL_SELECT = False
+_DBG_NO_ACCEPTS = False
+_DBG_NO_BUDGETS = False
+
 
 class OptimizationFailureException(Exception):
     """A hard goal could not be satisfied (reference:
@@ -158,8 +172,9 @@ def _channel_budgets(specs: Tuple[GoalSpec, ...], model: TensorClusterModel,
 def select_batched(score: Array, cand: Candidates, eligible: Array,
                    model: TensorClusterModel,
                    room_dest: Array, slack_src: Array,
-                   topic_guard: bool, disk_guard: bool,
-                   rounds: int = 6, subrounds: int = 4) -> Array:
+                   topic_budgets, disk_guard: bool,
+                   rounds: int = 6, subrounds: int = 4,
+                   has_swaps: bool = True) -> Array:
     """bool[K] — greedy multi-accept subset.
 
     Round-1's selection kept at most ONE action per source broker, per
@@ -181,10 +196,15 @@ def select_batched(score: Array, cand: Candidates, eligible: Array,
     round-2 verdict's 216-step ReplicaDistribution tail at the mid rung was
     one hot broker shedding 8 replicas per step.
 
-    Guards for goals whose metric is finer than a broker channel:
-    ``topic_guard`` limits a step to one action per (topic, src) and
-    (topic, dest) pair (TopicReplicaDistribution / MinTopicLeaders counts);
-    ``disk_guard`` to one landing per destination disk (intra-disk bands).
+    Goals whose metric is finer than a broker channel get their own
+    budgets: ``topic_budgets`` = (gain_rep, shed_rep, shed_lead), each
+    f32[T*B], bounds the cumulative per-(topic, broker) replica-count and
+    leader-count deltas of a step inside the optimized topic bands
+    (TopicReplicaDistribution / MinTopicLeaders).  Round 3 capped a step to
+    ONE action per (topic, broker) pair instead, which made the topic
+    goal's fixpoint as long as its worst pair's overage (90 of the mid
+    rung's 154 steps).  ``disk_guard`` still admits one landing per
+    destination disk per step (intra-disk bands).
     """
     num_brokers, num_partitions = model.num_brokers, model.num_partitions
     eps = 1e-6
@@ -211,13 +231,55 @@ def select_batched(score: Array, cand: Candidates, eligible: Array,
     cum_src = jnp.zeros((num_brokers, NUM_CHANNELS), jnp.float32)
     cum_dest = jnp.zeros((num_brokers, NUM_CHANNELS), jnp.float32)
     d_src, d_dest = _channel_deltas(cand)
-    if topic_guard:
-        t = model.replica_topic[cand.replica]
-        ts_key = t * num_brokers + cand.src
-        td_key = t * num_brokers + cand.dest
+    topic_on = topic_budgets is not None
+    if topic_on:
+        gain_rep, shed_rep, shed_lead = topic_budgets
         n_tb = model.num_topics * num_brokers
-        used_ts = jnp.zeros((n_tb,), bool)
-        used_td = jnp.zeros((n_tb,), bool)
+        t1 = model.replica_topic[cand.replica]
+        safe_r2 = jnp.where(cand.dest_replica >= 0, cand.dest_replica, 0)
+        t2 = model.replica_topic[safe_r2]
+        # Four (key, delta) legs per candidate on the (topic, broker) grid:
+        # the moved replica leaves (t1, src) and lands on (t1, dest); a
+        # swap's partner makes the reverse trip on its own topic.
+        moves_tb = cand.is_move() | cand.is_swap()
+        swap = cand.is_swap()
+        lead1 = (cand.is_leadership() |
+                 (moves_tb & model.replica_is_leader[cand.replica])
+                 ).astype(jnp.float32)
+        # Legs 3/4 exist only for swap batches (the partner's reverse trip
+        # on its own topic) — a statically swap-free goal keeps 2 legs.
+        if has_swaps:
+            # A same-topic swap nets to ZERO on the topic grid (the two legs
+            # of each key cancel); evaluating its legs independently would
+            # falsely reject it at band-edge pairs, so net the legs up
+            # front.  The leader channel nets likewise (lead1 vs lead2).
+            same_t = swap & (t1 == t2)
+            rep1 = jnp.where(same_t, 0.0, moves_tb.astype(jnp.float32))
+            rep2 = jnp.where(same_t, 0.0, swap.astype(jnp.float32))
+            leg_keys = jnp.stack([t1 * num_brokers + cand.src,
+                                  t1 * num_brokers + cand.dest,
+                                  t2 * num_brokers + cand.dest,
+                                  t2 * num_brokers + cand.src])   # i32[L, K]
+            d_rep = jnp.stack([-rep1, rep1, -rep2, rep2])         # f32[L, K]
+            lead2 = (swap & model.replica_is_leader[safe_r2]).astype(jnp.float32)
+            l1 = jnp.where(same_t, lead1 - lead2, lead1)
+            l2 = jnp.where(same_t, 0.0, lead2)
+            d_lead = jnp.stack([-l1, l1, -l2, l2])                # f32[L, K]
+        else:
+            leg_keys = jnp.stack([t1 * num_brokers + cand.src,
+                                  t1 * num_brokers + cand.dest])
+            d_rep = jnp.stack([-moves_tb.astype(jnp.float32),
+                               moves_tb.astype(jnp.float32)])
+            d_lead = jnp.stack([-lead1, lead1])
+        num_legs = leg_keys.shape[0]
+        cum_rep = jnp.zeros((n_tb,), jnp.float32)
+        cum_lead = jnp.zeros((n_tb,), jnp.float32)
+        eps_tb = 1e-6
+
+        def tb_ok(cum, d, gain, shed):
+            total = cum[leg_keys] + d
+            return ((total <= gain[leg_keys] + eps_tb) &
+                    (total >= -shed[leg_keys] - eps_tb)).all(axis=0)
     if disk_guard:
         safe_sd = jnp.maximum(cand.src_disk, 0)
         safe_dd = jnp.maximum(cand.dest_disk, 0)
@@ -241,30 +303,28 @@ def select_batched(score: Array, cand: Candidates, eligible: Array,
             (cum_net[cand.src] + d_src <= room_dest[cand.src] + eps)
         ).all(axis=1)
         elig = elig & budget_ok
-        if topic_guard:
-            elig = elig & ~used_ts[ts_key] & ~used_td[td_key]
+        if topic_on:
+            elig = elig & tb_ok(cum_rep, d_rep, gain_rep, shed_rep) & \
+                tb_ok(cum_lead, d_lead, jnp.inf * jnp.ones_like(gain_rep),
+                      shed_lead)
         if disk_guard:
             touches_disk = cand.dest_disk >= 0
             elig = elig & ~(touches_disk & (used_sdisk[safe_sd] | used_ddisk[safe_dd]))
         keep = _best_per_segment(score, src_lane, num_brokers * subrounds, elig)
         keep = _best_per_segment(score, dest_lane, num_brokers * subrounds, keep)
         keep = _best_per_segment(score, cand.partition, num_partitions, keep)
-        # Swaps involve a second partition — its uniqueness is absolute too.
-        keep = _best_per_segment(score, cand.partition2, num_partitions, keep)
-        # Cross-field collision: the two passes above are per-field, so one
-        # kept candidate's partition2 can still equal ANOTHER's partition
-        # (the same replica would be relocated twice in one round).  Drop
-        # the partition2-claimant of any such pair.
-        claim1 = jnp.zeros((num_partitions,), bool).at[
-            jnp.where(keep, cand.partition, 0)].max(keep)
-        keep = keep & ~((cand.partition2 != cand.partition) &
-                        claim1[cand.partition2])
-        # Guard keys are one-per-STEP: the cross-round `used_*` filters alone
-        # don't stop two lane winners sharing a key inside one round (two
-        # intra moves landing on the same disk oscillate forever).
-        if topic_guard:
-            keep = _best_per_segment(score, ts_key, n_tb, keep)
-            keep = _best_per_segment(score, td_key, n_tb, keep)
+        if has_swaps:
+            # Swaps involve a second partition — its uniqueness is absolute
+            # too.
+            keep = _best_per_segment(score, cand.partition2, num_partitions, keep)
+            # Cross-field collision: the two passes above are per-field, so
+            # one kept candidate's partition2 can still equal ANOTHER's
+            # partition (the same replica would be relocated twice in one
+            # round).  Drop the partition2-claimant of any such pair.
+            claim1 = jnp.zeros((num_partitions,), bool).at[
+                jnp.where(keep, cand.partition, 0)].max(keep)
+            keep = keep & ~((cand.partition2 != cand.partition) &
+                            claim1[cand.partition2])
         if disk_guard:
             touches = cand.dest_disk >= 0
             kd = _best_per_segment(score, safe_sd, model.num_disks,
@@ -282,10 +342,63 @@ def select_batched(score: Array, cand: Candidates, eligible: Array,
             s = s.at[jnp.where(k, cand.src, 0)].add(jnp.where(km, d_src, 0.0))
             return s
 
+        if topic_on:
+            def round_tb(k, d):
+                keys = jnp.where(k[None, :], leg_keys, 0)
+                return jnp.zeros((n_tb,), jnp.float32).at[keys.reshape(-1)].add(
+                    jnp.where(k[None, :], d, 0.0).reshape(-1))
+
+            def tb_viol(k):
+                rep = cum_rep + round_tb(k, d_rep)
+                lead = cum_lead + round_tb(k, d_lead)
+                return ((rep > gain_rep + eps_tb) |
+                        (rep < -shed_rep - eps_tb) |
+                        (lead < -shed_lead - eps_tb))
+
+            def leg_contrib(i, k):
+                return k & ((d_rep[i] != 0.0) | (d_lead[i] != 0.0))
+
+            # Per-key lanes + key-exact repair: the elig budget check bounds
+            # ONE candidate at a time, so many lane winners can pile onto a
+            # key with less room.  Admit up to 16 per key (lanes — wide
+            # enough that a hot pair drains at budget speed), then drop a
+            # violating key's extras down to its single best contributor —
+            # without nuking the whole broker (the broker-stage fallback
+            # below stays the last resort for cross-key flips).
+            nl = 16
+            lane_tb = (lane % nl).astype(jnp.int32)
+            for i in range(num_legs):
+                contrib = leg_contrib(i, keep)
+                sel = _best_per_segment(score, leg_keys[i] * nl + lane_tb,
+                                        n_tb * nl, contrib)
+                keep = keep & (~contrib | sel)
+
+            def _tb_repair(k):
+                vt = tb_viol(k)
+                for i in range(num_legs):
+                    contrib = leg_contrib(i, k)
+                    top1 = _best_per_segment(score, leg_keys[i], n_tb, contrib)
+                    k = k & (~(contrib & vt[leg_keys[i]]) | top1)
+                return k
+
+            # The repair passes run only when some key actually overshot —
+            # the common case (lanes within room) skips them entirely.
+            keep = jax.lax.cond(tb_viol(keep).any(), _tb_repair,
+                                lambda k: k, keep)
+
         def net_viol(k):
             total = cum_net + round_net(k)
-            return ((total > room_dest + eps) |
-                    (total < -slack_src - eps)).any(axis=1)
+            out = ((total > room_dest + eps) |
+                   (total < -slack_src - eps)).any(axis=1)
+            if topic_on:
+                tb_bad = tb_viol(k)
+                # Fold (topic, broker) violations onto the broker axis so the
+                # per-broker fallback stages and the final drop loop repair
+                # the rare cross-key flips too.
+                bad_b = jnp.zeros((num_brokers,), bool).at[
+                    jnp.arange(n_tb, dtype=jnp.int32) % num_brokers].max(tb_bad)
+                out = out | bad_b
+            return out
 
         # Exactness stages: a net-violating broker first falls back to its
         # single best dest-role action, then its single best src-role action
@@ -296,18 +409,25 @@ def select_batched(score: Array, cand: Candidates, eligible: Array,
         # The loop is monotone (a violating broker always has a kept action
         # to drop, since cum_net alone respects the bounds by induction), so
         # it terminates and the post-step state respects every band exactly.
-        viol = net_viol(keep)
-        top1_dest = _best_per_segment(score, cand.dest, num_brokers, keep)
-        keep = keep & (~viol[cand.dest] | top1_dest)
-        viol = net_viol(keep)
-        top1_src = _best_per_segment(score, cand.src, num_brokers, keep)
-        keep = keep & (~viol[cand.src] | top1_src)
-        def _drop_violators(k):
+        # The whole block is conditional: steps whose lane winners fit their
+        # budgets (the common case) skip every repair pass.
+        def _broker_repair(k):
             v = net_viol(k)
-            return k & ~v[cand.src] & ~v[cand.dest]
+            top1_dest = _best_per_segment(score, cand.dest, num_brokers, k)
+            k = k & (~v[cand.dest] | top1_dest)
+            v = net_viol(k)
+            top1_src = _best_per_segment(score, cand.src, num_brokers, k)
+            k = k & (~v[cand.src] | top1_src)
 
-        keep = jax.lax.while_loop(lambda k: net_viol(k).any(),
-                                  _drop_violators, keep)
+            def _drop_violators(kk):
+                vv = net_viol(kk)
+                return kk & ~vv[cand.src] & ~vv[cand.dest]
+
+            return jax.lax.while_loop(lambda kk: net_viol(kk).any(),
+                                      _drop_violators, k)
+
+        keep = jax.lax.cond(net_viol(keep).any(), _broker_repair,
+                            lambda k: k, keep)
 
         keep_total = keep_total | keep
         used_part = used_part.at[jnp.where(keep, cand.partition, 0)].max(keep)
@@ -317,9 +437,9 @@ def select_batched(score: Array, cand: Candidates, eligible: Array,
             jnp.where(km, d_src, 0.0))
         cum_dest = cum_dest.at[jnp.where(keep, cand.dest, 0)].add(
             jnp.where(km, d_dest, 0.0))
-        if topic_guard:
-            used_ts = used_ts.at[jnp.where(keep, ts_key, 0)].max(keep)
-            used_td = used_td.at[jnp.where(keep, td_key, 0)].max(keep)
+        if topic_on:
+            cum_rep = cum_rep + round_tb(keep, d_rep)
+            cum_lead = cum_lead + round_tb(keep, d_lead)
         if disk_guard:
             touches = keep & (cand.dest_disk >= 0)
             used_sdisk = used_sdisk.at[jnp.where(touches, safe_sd, 0)].max(touches)
@@ -330,6 +450,40 @@ def select_batched(score: Array, cand: Candidates, eligible: Array,
 # ---------------------------------------------------------------------------
 # The per-goal jitted step
 # ---------------------------------------------------------------------------
+
+def _topic_budgets(all_specs: Tuple[GoalSpec, ...], model: TensorClusterModel,
+                   arrays: BrokerArrays, constraint: BalancingConstraint):
+    """(gain_rep, shed_rep, shed_lead), each f32[T*B] — how much each
+    (topic, broker) pair may cumulatively gain / shed in replica count and
+    shed in leader count this step without leaving any optimized topic
+    band.  None when no topic-metric goal is in play."""
+    has_topic = any(s.kind == "topic_replica_distribution" for s in all_specs)
+    has_min_leaders = any(s.kind == "min_topic_leaders" for s in all_specs)
+    if not has_topic and not has_min_leaders:
+        return None
+    n_tb = model.num_topics * model.num_brokers
+    inf = jnp.full((n_tb,), jnp.inf, jnp.float32)
+    gain_rep = shed_rep = shed_lead = inf
+    alive_row = arrays.alive[None, :]
+    if has_topic:
+        tbc = model.topic_broker_replica_counts().astype(jnp.float32)
+        lower_t, upper_t = kernels._topic_limits(model, arrays, constraint)
+        gain = jnp.maximum(upper_t[:, None] - tbc, 0.0)
+        shed = jnp.maximum(tbc - lower_t[:, None], 0.0)
+        # Dead brokers shed without band limits (healing; mirrors the broker
+        # channel budgets' exemption).
+        shed = jnp.where(alive_row, shed, jnp.inf)
+        gain_rep, shed_rep = gain.reshape(-1), shed.reshape(-1)
+    if has_min_leaders:
+        tlc = model.topic_leader_counts().astype(jnp.float32)
+        designated = kernels._designated_topic_mask(model, constraint)
+        need = float(constraint.min_topic_leaders_per_broker)
+        shed = jnp.where(designated[:, None], jnp.maximum(tlc - need, 0.0),
+                         jnp.inf)
+        shed = jnp.where(alive_row, shed, jnp.inf)
+        shed_lead = shed.reshape(-1)
+    return gain_rep, shed_rep, shed_lead
+
 
 def _goal_step(model: TensorClusterModel, options: OptimizationOptions,
                spec: GoalSpec, prev_specs: Tuple[GoalSpec, ...],
@@ -385,24 +539,34 @@ def _goal_step(model: TensorClusterModel, options: OptimizationOptions,
         if not kernels.is_band_kind(prev):
             accepted = accepted & kernels.accepts(prev, model, arrays, cand,
                                                   constraint)
+    if _DBG_NO_ACCEPTS:
+        accepted = jnp.ones_like(accepted)
     score = kernels.score(spec, model, arrays, cand, constraint)
 
     eligible = cand.valid & feasible & accepted & (score > _MIN_SCORE)
     all_specs = (spec,) + prev_specs
     room_dest, slack_src = _channel_budgets(all_specs, model, arrays, constraint)
-    topic_guard = any(s.kind in ("topic_replica_distribution", "min_topic_leaders")
-                      for s in all_specs)
+    topic_budgets = _topic_budgets(all_specs, model, arrays, constraint)
+    if _DBG_NO_BUDGETS:
+        room_dest = jnp.full_like(room_dest, jnp.inf)
+        slack_src = jnp.full_like(slack_src, jnp.inf)
+        topic_budgets = None
     disk_guard = any(s.kind in ("intra_disk_capacity", "intra_disk_distribution")
                      for s in all_specs)
     # moves.per.step: each round keeps up to `subrounds` actions per broker,
     # so rounds = ceil(moves_per_broker_step / subrounds).  Lanes are nearly
     # free (same op count, bigger segment space); serial rounds are not —
     # prefer wide lanes over many rounds.
-    subrounds = 8
+    subrounds = SUBROUNDS
     rounds = max(1, -(-int(constraint.moves_per_broker_step) // subrounds))
-    keep = select_batched(score, cand, eligible, model, room_dest, slack_src,
-                          topic_guard, disk_guard, rounds=rounds,
-                          subrounds=subrounds)
+    if _DBG_TRIVIAL_SELECT:
+        keep = _best_per_segment(score, jnp.zeros(cand.k, jnp.int32), 1, eligible)
+    else:
+        keep = select_batched(score, cand, eligible, model, room_dest, slack_src,
+                              topic_budgets, disk_guard, rounds=rounds,
+                              subrounds=subrounds,
+                              has_swaps=bool(spec.uses_swaps
+                                             or spec.uses_intra_swaps))
     new_model = apply_candidates(model, cand, keep)
     return new_model, keep.sum()
 

@@ -2,11 +2,13 @@
 
 Parity with ``servlet/security/`` (SecurityProvider SPI; HTTP Basic in
 server.py): JWT bearer-token auth (security/jwt/JwtSecurityProvider +
-JwtAuthenticator) and trusted-proxy auth (security/trustedproxy/
+JwtAuthenticator), trusted-proxy auth (security/trustedproxy/
 TrustedProxySecurityProvider: an authenticated gateway forwards the end
-user in a ``doAs`` parameter).  SPNEGO/Kerberos is out of scope for a
-stdlib-only build (it needs a GSSAPI binding); the SPI seam accepts an
-external provider the same way.
+user in a ``doAs`` parameter), and SPNEGO/Kerberos over HTTP Negotiate
+(security/spnego/SpnegoSecurityProvider: challenge flow, principal
+short-name mapping, user-store roles; the GSS-API accept step is pluggable
+— python-gssapi when available, any Kerberos stack otherwise — exactly the
+step the reference delegates to Jetty's ConfigurableSpnegoLoginService).
 
 All stdlib: HS256 JWTs via hmac/hashlib/base64.
 """
@@ -97,6 +99,110 @@ class JwtSecurityProvider(SecurityProvider):
             if role in granted:
                 return role
         return None
+
+
+class KerberosName:
+    """Kerberos principal name parsing (the subset of
+    org.apache.kafka.common.security.kerberos.KerberosName the SPNEGO
+    provider needs): ``service/host@REALM``, ``user@REALM``, or a bare
+    short name; ``short_name`` is the first component — the default
+    auth-to-local rule the reference applies to map principals onto the
+    user store (SpnegoUserStoreAuthorizationService.java)."""
+
+    def __init__(self, principal: str):
+        self.principal = principal
+        rest, _, self.realm = principal.partition("@")
+        self.service_name, sep, self.host_name = rest.partition("/")
+        if not sep:
+            self.host_name = ""
+        self.short_name = self.service_name
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"KerberosName({self.principal!r})"
+
+
+class SpnegoSecurityProvider(SecurityProvider):
+    """SPNEGO / Kerberos over HTTP Negotiate (RFC 4559), the analogue of
+    ``servlet/security/spnego/SpnegoSecurityProvider.java``.
+
+    The provider owns the HTTP mechanics — the ``Negotiate`` challenge on
+    401, token extraction, principal → short-name mapping, and the
+    user-store role lookup (the same Jetty-realm file the Basic provider
+    reads; SpnegoUserStoreAuthorizationService semantics: principals not in
+    the store are rejected).  The GSS-API *accept* step itself is pluggable
+    (``gss_acceptor: bytes -> principal | None``): in production wrap your
+    Kerberos stack (e.g. python-gssapi with the service keytab named by
+    ``spnego.keytab.file`` / ``spnego.principal``); the reference equally
+    delegates this step to Jetty's ConfigurableSpnegoLoginService."""
+
+    def __init__(self, gss_acceptor=None,
+                 user_roles: Optional[Dict[str, str]] = None,
+                 keytab_path: str = "", principal: str = ""):
+        self._acceptor = gss_acceptor
+        self._user_roles = dict(user_roles or {})
+        self.keytab_path = keytab_path
+        self.principal = KerberosName(principal) if principal else None
+
+    def configure(self, config: Dict[str, object]) -> None:
+        from cruise_control_tpu.config import constants as C
+        self.keytab_path = str(config.get(C.SPNEGO_KEYTAB_FILE_CONFIG, "") or "")
+        principal = str(config.get(C.SPNEGO_PRINCIPAL_CONFIG, "") or "")
+        self.principal = KerberosName(principal) if principal else None
+        path = config.get(C.WEBSERVER_AUTH_CREDENTIALS_FILE_CONFIG)
+        if path:
+            from cruise_control_tpu.app import _load_credentials
+            self._user_roles = {user: role for user, (_, role)
+                                in _load_credentials(str(path)).items()}
+        if self._acceptor is None:
+            try:  # pragma: no cover - optional dependency
+                self._acceptor = _gssapi_acceptor(self.keytab_path,
+                                                  self.principal)
+            except ImportError as e:
+                raise RuntimeError(
+                    "SpnegoSecurityProvider needs a GSS-API acceptor: "
+                    "install python-gssapi or construct the provider with "
+                    f"gss_acceptor=... ({e})")
+
+    def challenge_headers(self) -> Dict[str, str]:
+        return {"WWW-Authenticate": "Negotiate"}
+
+    def authenticate(self, headers) -> Optional[str]:
+        auth = headers.get("Authorization", "")
+        if not auth.startswith("Negotiate "):
+            return None
+        try:
+            token = base64.b64decode(auth[len("Negotiate "):].strip())
+        except Exception:  # noqa: BLE001 — malformed token is a clean 401
+            return None
+        if self._acceptor is None:
+            return None
+        principal = self._acceptor(token)
+        if not principal:
+            return None
+        short = KerberosName(principal).short_name
+        role = self._user_roles.get(short)
+        return role.upper() if role and role.upper() in _ROLES else None
+
+
+def _gssapi_acceptor(keytab_path: str, principal: Optional[KerberosName]):
+    """Build a real GSS-API acceptor from python-gssapi (raises ImportError
+    when the binding is absent — the stdlib cannot validate Kerberos
+    tickets)."""
+    import gssapi  # noqa: F401 — optional, not in the base image
+
+    store = {"keytab": keytab_path} if keytab_path else None
+    name = None
+    if principal is not None:
+        name = gssapi.Name(principal.principal,
+                           gssapi.NameType.kerberos_principal)
+    creds = gssapi.Credentials(usage="accept", name=name, store=store)
+
+    def accept(token: bytes) -> Optional[str]:
+        ctx = gssapi.SecurityContext(creds=creds, usage="accept")
+        ctx.step(token)
+        return str(ctx.initiator_name) if ctx.complete else None
+
+    return accept
 
 
 class TrustedProxySecurityProvider(SecurityProvider):

@@ -156,7 +156,11 @@ class CruiseControlApi:
                          "validEndpoints": sorted(valid)}, {}
         role = self.security.authenticate(headers or {})
         if role is None:
-            return 401, {"error": "authentication required"}, {}
+            # Challenge-based schemes (SPNEGO's Negotiate) advertise the
+            # mechanism on rejection (RFC 4559 §4.1).
+            challenge = getattr(self.security, "challenge_headers", None)
+            return 401, {"error": "authentication required"}, \
+                (challenge() if callable(challenge) else {})
         if _ROLE_RANK[role] < _ROLE_RANK[_ENDPOINT_ROLE[endpoint]]:
             return 403, {"error": f"endpoint {endpoint} requires "
                                   f"{_ENDPOINT_ROLE[endpoint]}"}, {}

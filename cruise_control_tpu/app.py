@@ -91,7 +91,9 @@ class KafkaCruiseControlApp:
             from cruise_control_tpu.kafka.sampler import KafkaMetricSampler
             from cruise_control_tpu.kafka.maintenance import MAINTENANCE_TOPIC
             from cruise_control_tpu.kafka.sample_store import (
-                BROKER_SAMPLES_TOPIC, PARTITION_SAMPLES_TOPIC)
+                BROKER_SAMPLES_TOPIC, ON_EXECUTION_SAMPLES_TOPIC,
+                PARTITION_SAMPLES_TOPIC,
+                KafkaPartitionMetricSampleOnExecutionStore)
             from cruise_control_tpu.reporter.agent import METRICS_TOPIC
 
             self._kafka_client = KafkaClient(bootstrap)
@@ -100,7 +102,8 @@ class KafkaCruiseControlApp:
             # counting them deflated monitored-partition percentage below
             # min.valid.partition.ratio on small clusters.
             internal = (METRICS_TOPIC, PARTITION_SAMPLES_TOPIC,
-                        BROKER_SAMPLES_TOPIC, MAINTENANCE_TOPIC)
+                        BROKER_SAMPLES_TOPIC, ON_EXECUTION_SAMPLES_TOPIC,
+                        MAINTENANCE_TOPIC)
             self.metadata_client = MetadataClient(
                 cluster_metadata_from_kafka(self._kafka_client, internal))
             self._refresher = KafkaMetadataRefresher(
@@ -108,6 +111,8 @@ class KafkaCruiseControlApp:
                 exclude_topics=internal)
             self.sampler: MetricSampler = KafkaMetricSampler(self._kafka_client)
             store: SampleStore = KafkaSampleStore(self._kafka_client)
+            on_execution_store: Optional[SampleStore] = \
+                KafkaPartitionMetricSampleOnExecutionStore(self._kafka_client)
             self.admin = KafkaClusterAdmin(self._kafka_client)
         else:
             from cruise_control_tpu.executor.admin import InMemoryClusterAdmin
@@ -117,6 +122,7 @@ class KafkaCruiseControlApp:
                 C.METRIC_SAMPLER_CLASS_CONFIG, MetricSampler)
             store = cfg.get_configured_instance(
                 C.SAMPLE_STORE_CLASS_CONFIG, SampleStore)
+            on_execution_store = None
             self.admin = InMemoryClusterAdmin(self.metadata_client)
 
         capacity_file = cfg.get(C.CAPACITY_CONFIG_FILE_CONFIG)
@@ -140,7 +146,8 @@ class KafkaCruiseControlApp:
             min_samples_per_broker_window=cfg.get(
                 C.MIN_SAMPLES_PER_BROKER_METRICS_WINDOW_CONFIG),
             max_allowed_broker_extrapolations=cfg.get(
-                C.MAX_ALLOWED_EXTRAPOLATIONS_PER_BROKER_CONFIG))
+                C.MAX_ALLOWED_EXTRAPOLATIONS_PER_BROKER_CONFIG),
+            on_execution_store=on_execution_store)
         throttle_rate = cfg.get(C.DEFAULT_REPLICATION_THROTTLE_CONFIG)
         # The executor's wait loop must observe reassignment completion:
         # with Kafka bindings it reads a refreshing view (every poll hits
@@ -178,8 +185,9 @@ class KafkaCruiseControlApp:
                 C.REMOVED_BROKERS_RETENTION_MS_CONFIG),
             demoted_broker_retention_ms=cfg.get(
                 C.DEMOTED_BROKERS_RETENTION_MS_CONFIG),
-            on_sampling_pause=self.load_monitor.pause_sampling,
-            on_sampling_resume=self.load_monitor.resume_sampling,
+            on_sampling_pause=lambda reason: self.load_monitor.set_execution_mode(
+                True, reason),
+            on_sampling_resume=lambda: self.load_monitor.set_execution_mode(False),
             min_isr_pressure_fn=lambda: min_isr_pressure(
                 executor_metadata.cluster(), isr_cache),
             progress_check_interval_ms=cfg.get(

@@ -409,6 +409,8 @@ WEBSERVER_HTTP_ADDRESS_CONFIG = "webserver.http.address"
 WEBSERVER_API_URLPREFIX_CONFIG = "webserver.api.urlprefix"
 WEBSERVER_SECURITY_ENABLE_CONFIG = "webserver.security.enable"
 WEBSERVER_SECURITY_PROVIDER_CONFIG = "webserver.security.provider"
+SPNEGO_KEYTAB_FILE_CONFIG = "spnego.keytab.file"
+SPNEGO_PRINCIPAL_CONFIG = "spnego.principal"
 WEBSERVER_AUTH_CREDENTIALS_FILE_CONFIG = "webserver.auth.credentials.file"
 TWO_STEP_VERIFICATION_ENABLED_CONFIG = "two.step.verification.enabled"
 TWO_STEP_PURGATORY_RETENTION_TIME_MS_CONFIG = "two.step.purgatory.retention.time.ms"
@@ -434,6 +436,10 @@ def webserver_config_def() -> ConfigDef:
              importance=Importance.MEDIUM, doc="Security provider plugin.", group="webserver")
     d.define(WEBSERVER_AUTH_CREDENTIALS_FILE_CONFIG, Type.STRING, "", importance=Importance.MEDIUM,
              doc="Credentials file for basic auth.", group="webserver")
+    d.define(SPNEGO_KEYTAB_FILE_CONFIG, Type.STRING, "", importance=Importance.LOW,
+             doc="Service keytab for the SPNEGO security provider.", group="webserver")
+    d.define(SPNEGO_PRINCIPAL_CONFIG, Type.STRING, "", importance=Importance.LOW,
+             doc="SPNEGO service principal (service/host@REALM).", group="webserver")
     d.define(TWO_STEP_VERIFICATION_ENABLED_CONFIG, Type.BOOLEAN, False, importance=Importance.MEDIUM,
              doc="Park POST requests for admin review before running.", group="webserver")
     d.define(TWO_STEP_PURGATORY_RETENTION_TIME_MS_CONFIG, Type.LONG, 1209600000, Range.at_least(1),

@@ -23,6 +23,7 @@ from cruise_control_tpu.monitor.sampling import (BrokerMetricSample,
 
 PARTITION_SAMPLES_TOPIC = "__KafkaCruiseControlPartitionMetricSamples"
 BROKER_SAMPLES_TOPIC = "__KafkaCruiseControlModelTrainingSamples"
+ON_EXECUTION_SAMPLES_TOPIC = "__KafkaCruiseControlPartitionMetricSampleOnExecution"
 
 
 class KafkaSampleStore(SampleStore):
@@ -122,3 +123,45 @@ class ReadOnlyKafkaSampleStore(SampleStore):
 
     def load_samples(self) -> Samples:
         return self._delegate.load_samples()
+
+
+class KafkaPartitionMetricSampleOnExecutionStore(SampleStore):
+    """Segregated store for partition samples taken while an execution is in
+    flight (KafkaPartitionMetricSampleOnExecutionStore.java): rebalance
+    traffic biases partition metrics, so they are kept out of the main
+    sample store / aggregation windows and parked in their own short-
+    retention topic (reference default: 1 h) for inspection."""
+
+    def __init__(self, client: KafkaClient,
+                 topic: str = ON_EXECUTION_SAMPLES_TOPIC,
+                 topic_partitions: int = 1,
+                 retention_ms: int = 3600_000):
+        self._client = client
+        self._topic = topic
+        self._nparts = topic_partitions
+        self._retention_ms = retention_ms
+        self._ensured = False
+
+    def _ensure_topic(self) -> None:
+        if self._ensured:
+            return
+        errors = self._client.create_topics(
+            {self._topic: (self._nparts, 1)},
+            configs={self._topic: {"retention.ms": str(self._retention_ms),
+                                   "compression.type": "none"}})
+        for topic, code in errors.items():
+            if code not in (0, 36):
+                raise KafkaError(code, f"creating {topic}")
+        self._ensured = True
+
+    def store_samples(self, samples: Samples) -> None:
+        if not samples.partition_samples:
+            return
+        self._ensure_topic()
+        payloads = [s.to_json() for s in samples.partition_samples]
+        records = [Record(key=None, value=p.encode()) for p in payloads]
+        self._client.produce((self._topic, 0), records)
+
+    def load_samples(self):
+        """On-execution samples are never replayed into the windows."""
+        return Samples(partition_samples=[], broker_samples=[])

@@ -96,8 +96,12 @@ class KafkaMetricSampler(MetricSampler):
         want_partitions = mode in (SamplingMode.ALL,
                                    SamplingMode.PARTITION_METRICS_ONLY,
                                    SamplingMode.ONGOING_EXECUTION)
+        # ONGOING_EXECUTION still collects broker metrics — the
+        # ConcurrencyAdjuster reads live health during execution; only the
+        # partition samples are segregated downstream.
         want_brokers = mode in (SamplingMode.ALL,
-                                SamplingMode.BROKER_METRICS_ONLY)
+                                SamplingMode.BROKER_METRICS_ONLY,
+                                SamplingMode.ONGOING_EXECUTION)
         samples = self._processor.process(cluster, partitions,
                                           time_ms=end_ms - 1)
         return Samples(samples.partition_samples if want_partitions else [],

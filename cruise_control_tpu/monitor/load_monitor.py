@@ -93,7 +93,8 @@ class LoadMonitor:
                  max_allowed_extrapolations: int = 5,
                  min_samples_per_broker_window: Optional[int] = None,
                  max_allowed_broker_extrapolations: Optional[int] = None,
-                 follower_cpu_ratio: float = DEFAULT_CPU_WEIGHT_OF_FOLLOWER):
+                 follower_cpu_ratio: float = DEFAULT_CPU_WEIGHT_OF_FOLLOWER,
+                 on_execution_store: Optional[SampleStore] = None):
         self._metadata = metadata_client
         self._capacity = capacity_resolver or StaticCapacityResolver()
         self._store = sample_store or NoopSampleStore()
@@ -116,6 +117,14 @@ class LoadMonitor:
         self._state = LoadMonitorState.NOT_STARTED
         self._sampling_paused = False
         self._pause_reason: Optional[str] = None
+        # Execution-time segregation (adjustSamplingModeBeforeExecution,
+        # Executor.java:1051-1067 + KafkaPartitionMetricSampleOnExecutionStore):
+        # while the executor runs, partition samples are rebalance-biased —
+        # they are diverted to this store instead of the aggregator/main
+        # store; broker samples keep flowing (the ConcurrencyAdjuster needs
+        # live health).
+        self._execution_mode = False
+        self._on_execution_store = on_execution_store
         # Model-generation semaphore (LoadMonitor.java:92,165): bounds
         # concurrent model builds.
         self._model_semaphore = threading.Semaphore(2)
@@ -159,6 +168,18 @@ class LoadMonitor:
             self._sampling_paused = False
             self._pause_reason = None
 
+    def set_execution_mode(self, active: bool, reason: str = "") -> None:
+        """Executor hook: switch sampling to ONGOING_EXECUTION instead of a
+        full pause — broker metrics continue (live health for the
+        ConcurrencyAdjuster), partition metrics divert to the segregated
+        on-execution store.  An operator pause's reason is never clobbered
+        (the execution only annotates the reason while nothing else owns it)."""
+        with self._lock:
+            self._execution_mode = active
+            if not self._sampling_paused:
+                self._pause_reason = ((reason or "ongoing execution")
+                                      if active else None)
+
     @property
     def pause_reason(self) -> Optional[str]:
         return self._pause_reason
@@ -176,10 +197,30 @@ class LoadMonitor:
             if self._sampling_paused:
                 return 0
             effective = mode
+            if self._execution_mode and mode == SamplingMode.ALL:
+                effective = SamplingMode.ONGOING_EXECUTION
         cluster = self._metadata.cluster()
         tps = [p.tp for p in cluster.partitions]
         samples = sampler.get_samples(cluster, tps, start_ms, end_ms, effective)
+        if effective == SamplingMode.ONGOING_EXECUTION:
+            return self._ingest_on_execution(samples)
         return self._ingest(samples, persist=True)
+
+    def _ingest_on_execution(self, samples: Samples) -> int:
+        """Broker samples flow normally (aggregated AND persisted, so
+        broker-window history has no restart gap across a long execution);
+        partition samples (biased by the rebalance traffic itself) go only
+        to the segregated store."""
+        n = self.broker_aggregator.add_samples(
+            [(bs.entity, bs.time_ms, bs.metrics) for bs in samples.broker_samples])
+        if samples.broker_samples and n:
+            self._store.store_samples(Samples(
+                partition_samples=[], broker_samples=samples.broker_samples))
+        if samples.partition_samples and self._on_execution_store is not None:
+            self._on_execution_store.store_samples(Samples(
+                partition_samples=samples.partition_samples,
+                broker_samples=[]))
+        return n
 
     def bootstrap(self, sampler: MetricSampler, start_ms: int, end_ms: int,
                   step_ms: Optional[int] = None) -> int:

@@ -134,7 +134,11 @@ class PrometheusMetricSampler(MetricSampler):
         want_partitions = mode in (SamplingMode.ALL,
                                    SamplingMode.PARTITION_METRICS_ONLY,
                                    SamplingMode.ONGOING_EXECUTION)
+        # ONGOING_EXECUTION still collects broker metrics — the
+        # ConcurrencyAdjuster reads live health during execution; only the
+        # partition samples are segregated downstream.
         want_brokers = mode in (SamplingMode.ALL,
-                                SamplingMode.BROKER_METRICS_ONLY)
+                                SamplingMode.BROKER_METRICS_ONLY,
+                                SamplingMode.ONGOING_EXECUTION)
         return Samples(samples.partition_samples if want_partitions else [],
                        samples.broker_samples if want_brokers else [])

@@ -112,7 +112,8 @@ class SyntheticWorkloadSampler(MetricSampler):
         t = end_ms
         want_partitions = mode in (SamplingMode.ALL, SamplingMode.PARTITION_METRICS_ONLY,
                                    SamplingMode.ONGOING_EXECUTION)
-        want_brokers = mode in (SamplingMode.ALL, SamplingMode.BROKER_METRICS_ONLY)
+        want_brokers = mode in (SamplingMode.ALL, SamplingMode.BROKER_METRICS_ONLY,
+                                SamplingMode.ONGOING_EXECUTION)
         # Broker CPU derives from the leaders' workloads, so compute the
         # per-partition rows regardless of mode and only *emit* them when the
         # mode asks for partition samples.

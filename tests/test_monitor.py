@@ -265,3 +265,48 @@ def test_broker_health_metrics_feed():
     limits = ConcurrencyLimits(inter_broker_per_broker=2)
     grown = adj.adjust(limits, health)
     assert grown.inter_broker_per_broker == 4
+
+
+def test_execution_mode_segregates_partition_samples():
+    """During an execution, partition samples divert to the on-execution
+    store (KafkaPartitionMetricSampleOnExecutionStore semantics) while
+    broker samples keep flowing for the ConcurrencyAdjuster; a full operator
+    pause still stops everything."""
+    md = make_metadata()
+
+    class RecordingStore:
+        def __init__(self):
+            self.partition_samples = []
+            self.broker_samples = []
+
+        def store_samples(self, samples):
+            self.partition_samples += samples.partition_samples
+            self.broker_samples += samples.broker_samples
+
+        def load_samples(self):
+            from cruise_control_tpu.monitor.sampling import Samples
+            return Samples(partition_samples=[], broker_samples=[])
+
+    main_store, exec_store = RecordingStore(), RecordingStore()
+    lm = LoadMonitor(MetadataClient(md), sample_store=main_store,
+                     on_execution_store=exec_store)
+    sampler = SyntheticWorkloadSampler()
+
+    n = lm.fetch_once(sampler, 0, W)
+    assert n > 0 and main_store.partition_samples  # normal flow
+
+    before_p = lm.partition_aggregator.generation
+    main_p = len(main_store.partition_samples)
+    lm.set_execution_mode(True, "ongoing execution")
+    assert lm.fetch_once(sampler, W, 2 * W) > 0  # broker samples ingested
+    assert exec_store.partition_samples            # diverted
+    assert not exec_store.broker_samples
+    assert len(main_store.partition_samples) == main_p  # main store untouched
+    assert lm.partition_aggregator.generation == before_p  # windows untouched
+
+    lm.set_execution_mode(False)
+    assert lm.fetch_once(sampler, 2 * W, 3 * W) > 0
+    assert len(main_store.partition_samples) > main_p
+
+    lm.pause_sampling("operator pause")
+    assert lm.fetch_once(sampler, 3 * W, 4 * W) == 0

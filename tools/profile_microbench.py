@@ -1,0 +1,52 @@
+"""Microbenchmarks of on-device primitive costs (scatter/segment ops,
+gathers, per-step kernel bodies) — the distilled survivors of round-3's
+ad-hoc `_profile_*` scripts.  Times N iterations INSIDE one jit
+(fori_loop with a data dependency) so tunnel/dispatch overhead is excluded.
+
+Usage: python tools/profile_microbench.py [R B P K N]
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+R, B, P, K = 10240, 56, 3400, 20800
+N = 300
+if len(sys.argv) > 1:
+    R, B, P, K, N = (int(a) for a in sys.argv[1:6])
+
+key = jax.random.PRNGKey(0)
+vals = jax.random.normal(key, (R,))
+vals4 = jax.random.normal(key, (R, 4))
+idx = jax.random.randint(key, (R,), 0, B)
+kscore = jax.random.normal(key, (K,))
+kseg = jax.random.randint(key, (K,), 0, B)
+
+
+def timed(name, fn):
+    f = jax.jit(fn)
+    jax.block_until_ready(f())  # compile once
+    t0 = time.monotonic()
+    jax.block_until_ready(f())
+    dt = (time.monotonic() - t0) / N * 1e6
+    print(f"{name:40s} {dt:9.1f} us/iter")
+
+
+def loop(body):
+    def fn():
+        def it(i, acc):
+            return acc + body(acc)
+        return jax.lax.fori_loop(0, N, it, jnp.float32(0))
+    return fn
+
+
+timed("elementwise (sin+mul) over R", loop(lambda a: (jnp.sin(vals + a) * 2.0).sum()))
+timed("segment-sum scatter R->B", loop(
+    lambda a: jnp.zeros((B,), jnp.float32).at[idx].add(vals + a).sum()))
+timed("segment-max scatter K->B", loop(
+    lambda a: jnp.full((B,), -jnp.inf, jnp.float32).at[kseg].max(kscore + a).sum()))
+timed("one-hot matmul R->B (4 cols)", loop(
+    lambda a: ((jax.nn.one_hot(idx, B, dtype=jnp.float32).T @ (vals4 + a))).sum()))
+timed("gather R->K (dynamic indices)", loop(
+    lambda a: (vals[(kseg * 131 + a.astype(jnp.int32)) % R]).sum()))

@@ -1,0 +1,363 @@
+"""Sequential greedy baseline — the honest stand-in for the stock JVM
+analyzer (no JVM exists in this image).
+
+Reimplements the reference's per-replica greedy semantics in plain NumPy:
+goals run in priority order; each goal loops brokers (most-violating
+first), each broker's replicas (largest contribution first), and candidate
+destination brokers (most headroom first), applying the FIRST candidate
+action that is a legit move, self-satisfied for the current goal, and
+accepted by every previously-optimized goal — exactly
+AbstractGoal.optimize → rebalanceForBroker → maybeApplyBalancingAction
+(AbstractGoal.java:82-119, :224-266, ResourceDistributionGoal.java:383-535).
+Passes repeat until a full sweep applies nothing.
+
+"Plans scored" counts candidate (replica, destination) evaluations — the
+same unit the TPU path reports — so the two implementations are compared
+on both wall-clock and throughput for identical model snapshots.
+
+Usage:
+    BENCH_SCALE=mid python tools/sequential_baseline.py
+prints one JSON line: {"scale", "wall_s", "plans_scored", "plans_per_sec",
+"actions", "hard_goals_satisfied"}.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BALANCE_MARGIN = 0.9
+
+# (name, kind, resource, hard) in the bench stack's priority order.
+GOALS = [
+    ("RackAwareGoal", "rack", -1, True),
+    ("ReplicaCapacityGoal", "replica_capacity", -1, True),
+    ("DiskCapacityGoal", "capacity", 3, True),
+    ("NetworkInboundCapacityGoal", "capacity", 1, True),
+    ("NetworkOutboundCapacityGoal", "capacity", 2, True),
+    ("CpuCapacityGoal", "capacity", 0, True),
+    ("ReplicaDistributionGoal", "replica_distribution", -1, False),
+    ("PotentialNwOutGoal", "potential_nw_out", -1, False),
+    ("DiskUsageDistributionGoal", "resource_distribution", 3, False),
+    ("NetworkInboundUsageDistributionGoal", "resource_distribution", 1, False),
+    ("NetworkOutboundUsageDistributionGoal", "resource_distribution", 2, False),
+    ("CpuUsageDistributionGoal", "resource_distribution", 0, False),
+    ("TopicReplicaDistributionGoal", "topic_replica_distribution", -1, False),
+    ("LeaderReplicaDistributionGoal", "leader_replica_distribution", -1, False),
+    ("LeaderBytesInDistributionGoal", "leader_bytes_in", -1, False),
+]
+
+CAP_THRESH = {0: 0.7, 1: 0.8, 2: 0.8, 3: 0.8}
+BAL_THRESH = 1.1
+MAX_REPLICAS_PER_BROKER = 10_000
+
+
+class SeqState:
+    """Mutable NumPy mirror of the tensor model with incremental broker
+    aggregates (the reference's ClusterModel bookkeeping,
+    ClusterModel.java:377-431)."""
+
+    def __init__(self, model):
+        self.rb = np.asarray(model.replica_broker).copy()
+        self.rp = np.asarray(model.replica_partition)
+        self.rt = np.asarray(model.replica_topic)
+        self.lead = np.asarray(model.replica_is_leader).copy()
+        self.valid = np.asarray(model.replica_valid)
+        self.load_lead = np.asarray(model.replica_load_leader)
+        self.load_foll = np.asarray(model.replica_load_follower)
+        self.part_replicas = np.asarray(model.partition_replicas)
+        self.rack = np.asarray(model.broker_rack)
+        self.cap = np.asarray(model.broker_capacity)
+        self.B = self.cap.shape[0]
+        self.T = int(self.rt.max()) + 1
+        self.alive = np.ones(self.B, bool)
+        self.plans_scored = 0
+        self.actions = 0
+        self._rebuild()
+
+    def rload(self):
+        return np.where(self.lead[:, None], self.load_lead, self.load_foll)
+
+    def _rebuild(self):
+        rl = self.rload()
+        self.bload = np.zeros((self.B, 4), np.float64)
+        np.add.at(self.bload, self.rb[self.valid], rl[self.valid])
+        self.bcount = np.bincount(self.rb[self.valid], minlength=self.B)
+        self.lcount = np.bincount(self.rb[self.valid & self.lead],
+                                  minlength=self.B)
+        self.lbytes = np.zeros(self.B, np.float64)
+        np.add.at(self.lbytes, self.rb[self.valid & self.lead],
+                  self.load_lead[self.valid & self.lead, 1])
+        self.tbc = np.zeros((self.T, self.B), np.int64)
+        np.add.at(self.tbc, (self.rt[self.valid], self.rb[self.valid]), 1)
+
+    # -- incremental move (relocateReplica, ClusterModel.java:377-393) -----
+    def apply_move(self, r, dest):
+        src = self.rb[r]
+        rl = self.load_lead[r] if self.lead[r] else self.load_foll[r]
+        self.bload[src] -= rl
+        self.bload[dest] += rl
+        self.bcount[src] -= 1
+        self.bcount[dest] += 1
+        if self.lead[r]:
+            self.lcount[src] -= 1
+            self.lcount[dest] += 1
+            self.lbytes[src] -= self.load_lead[r, 1]
+            self.lbytes[dest] += self.load_lead[r, 1]
+        self.tbc[self.rt[r], src] -= 1
+        self.tbc[self.rt[r], dest] += 1
+        self.rb[r] = dest
+        self.actions += 1
+
+    def sibling_brokers(self, r):
+        sib = self.part_replicas[self.rp[r]]
+        sib = sib[(sib >= 0) & (sib != r)]
+        return self.rb[sib]
+
+    # -- goal metric / limits ---------------------------------------------
+    def metric(self, kind, res):
+        if kind in ("capacity", "resource_distribution"):
+            return self.bload[:, res]
+        if kind in ("replica_capacity", "replica_distribution"):
+            return self.bcount.astype(np.float64)
+        if kind == "leader_replica_distribution":
+            return self.lcount.astype(np.float64)
+        if kind == "leader_bytes_in":
+            return self.lbytes
+        if kind == "potential_nw_out":
+            pot = np.zeros(self.B, np.float64)
+            np.add.at(pot, self.rb[self.valid], self.load_lead[self.valid, 2])
+            return pot
+        raise NotImplementedError(kind)
+
+    def limits(self, kind, res):
+        if kind == "capacity":
+            return np.zeros(self.B), self.cap[:, res] * CAP_THRESH[res]
+        if kind == "potential_nw_out":
+            return np.zeros(self.B), self.cap[:, 2] * CAP_THRESH[2]
+        if kind == "replica_capacity":
+            return np.zeros(self.B), np.full(self.B, MAX_REPLICAS_PER_BROKER,
+                                             np.float64)
+        bp = (BAL_THRESH - 1.0) * BALANCE_MARGIN + 1.0
+        if kind == "resource_distribution":
+            avg_pct = self.bload[:, res].sum() / max(self.cap[:, res].sum(), 1e-9)
+            return (avg_pct * (2.0 - bp) * self.cap[:, res],
+                    avg_pct * bp * self.cap[:, res])
+        if kind == "replica_distribution":
+            avg = self.bcount.sum() / self.B
+            return (np.full(self.B, np.floor(avg * (2.0 - bp))),
+                    np.full(self.B, np.ceil(avg * bp)))
+        if kind == "leader_replica_distribution":
+            avg = self.lcount.sum() / self.B
+            return (np.full(self.B, np.floor(avg * (2.0 - bp))),
+                    np.full(self.B, np.ceil(avg * bp)))
+        if kind == "leader_bytes_in":
+            avg = self.lbytes.sum() / self.B
+            return np.zeros(self.B), np.full(self.B, avg * bp)
+        raise NotImplementedError(kind)
+
+    def topic_limits(self):
+        bp = (BAL_THRESH - 1.0) * BALANCE_MARGIN + 1.0
+        avg = self.tbc.sum(axis=1) / self.B
+        return np.floor(avg * (2.0 - bp)), np.ceil(avg * bp)
+
+    def rack_conflict_count(self):
+        out = np.zeros(self.B, np.int64)
+        racks = self.rack[self.rb]
+        for p in range(self.part_replicas.shape[0]):
+            sib = self.part_replicas[p]
+            sib = sib[sib >= 0]
+            if sib.size < 2:
+                continue
+            rr = racks[sib]
+            seen = {}
+            for r, rk in zip(sib, rr):
+                if rk in seen:
+                    out[self.rb[r]] += 1
+                else:
+                    seen[rk] = r
+        return out
+
+    def goal_satisfied(self, name, kind, res):
+        if kind == "rack":
+            return self.rack_conflict_count().sum() == 0
+        if kind == "topic_replica_distribution":
+            lo, up = self.topic_limits()
+            return bool(((self.tbc <= up[:, None]) &
+                         (self.tbc >= lo[:, None])).all())
+        m = self.metric(kind, res)
+        lo, up = self.limits(kind, res)
+        return bool(((m <= up + 1e-6) & (m >= lo - 1e-6)).all())
+
+
+def accepts_all(state, prev, r, dest, rl):
+    """Cross-goal veto: every previously optimized goal's actionAcceptance
+    (AnalyzerUtils.java:117)."""
+    src = state.rb[r]
+    for (name, kind, res, hard) in prev:
+        if kind == "rack":
+            if (state.sibling_brokers(r) == dest).any():
+                return False
+            continue
+        if kind == "topic_replica_distribution":
+            lo, up = state.topic_limits()
+            t = state.rt[r]
+            if state.tbc[t, dest] + 1 > up[t]:
+                return False
+            if state.tbc[t, src] - 1 < lo[t]:
+                return False
+            continue
+        m = state.metric(kind, res)
+        lo, up = state.limits(kind, res)
+        d = delta_for(state, kind, res, r, rl)
+        if d == 0.0:
+            continue
+        if m[dest] + d > up[dest]:
+            return False
+        if kind not in ("capacity", "replica_capacity", "potential_nw_out",
+                        "leader_bytes_in") and m[src] - d < lo[src]:
+            return False
+    return True
+
+
+def delta_for(state, kind, res, r, rl):
+    if kind in ("capacity", "resource_distribution"):
+        return rl[res]
+    if kind in ("replica_capacity", "replica_distribution"):
+        return 1.0
+    if kind == "leader_replica_distribution":
+        return 1.0 if state.lead[r] else 0.0
+    if kind == "potential_nw_out":
+        return state.load_lead[r, 2]
+    if kind == "leader_bytes_in":
+        return state.load_lead[r, 1] if state.lead[r] else 0.0
+    return 0.0
+
+
+def optimize_goal(state, name, kind, res, prev):
+    """One goal to its fixpoint (AbstractGoal.optimize): sweep brokers until
+    a full pass applies nothing."""
+    for _sweep in range(256):
+        applied = 0
+        if kind == "rack":
+            conflicts = state.rack_conflict_count()
+            order = np.argsort(-conflicts)
+        else:
+            m = state.metric(kind, res)
+            lo, up = state.limits(kind, res)
+            order = np.argsort(-(m - up))
+        for src in order:
+            if kind == "rack":
+                pass
+            else:
+                m = state.metric(kind, res)
+                lo, up = state.limits(kind, res)
+                if m[src] <= up[src] + 1e-9:
+                    continue
+            replicas = np.nonzero(state.valid & (state.rb == src))[0]
+            rload = state.rload()
+            if kind == "rack":
+                mask = np.array([(state.sibling_brokers(r) ==
+                                  state.rack[state.rb[r]]).any() or
+                                 (state.rack[state.sibling_brokers(r)] ==
+                                  state.rack[src]).any()
+                                 for r in replicas])
+                replicas = replicas[mask] if mask.size else replicas[:0]
+            # Largest contribution first (SortedReplicas semantics).
+            key = rload[replicas, res if res >= 0 else 3]
+            replicas = replicas[np.argsort(-key)]
+            for r in replicas:
+                rl = rload[r]
+                order_metric = (state.bcount.astype(np.float64) if kind == "rack"
+                                else state.metric(kind, res))
+                dests = np.argsort(order_metric /
+                                   np.maximum(state.cap[:, res if res >= 0 else 3],
+                                              1e-9))
+                moved = False
+                for dest in dests:
+                    if dest == src:
+                        continue
+                    state.plans_scored += 1
+                    if (state.sibling_brokers(r) == dest).any():
+                        continue
+                    # selfSatisfied: the move must not push dest over / src
+                    # under the goal's own band.
+                    if kind == "rack":
+                        own_rack_conflict = (state.rack[state.sibling_brokers(r)]
+                                             == state.rack[src]).any()
+                        dest_conflict = (state.rack[state.sibling_brokers(r)]
+                                         == state.rack[dest]).any()
+                        if not own_rack_conflict or dest_conflict:
+                            continue
+                    else:
+                        m = state.metric(kind, res)
+                        lo, up = state.limits(kind, res)
+                        d = delta_for(state, kind, res, r, rl)
+                        if d <= 0 or m[dest] + d > up[dest] + 1e-9:
+                            continue
+                    if not accepts_all(state, prev, r, dest, rl):
+                        continue
+                    state.apply_move(r, dest)
+                    applied += 1
+                    moved = True
+                    break
+                if moved and kind != "rack":
+                    m = state.metric(kind, res)
+                    lo, up = state.limits(kind, res)
+                    if m[src] <= up[src] + 1e-9:
+                        break
+        if applied == 0:
+            return
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from bench import SCALES
+    from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster
+
+    scale = os.environ.get("BENCH_SCALE", "mid")
+    brokers, racks, topics, ppt, rf = SCALES[scale]
+    model = generate_cluster(ClusterSpec(
+        num_brokers=brokers, num_racks=racks, num_topics=topics,
+        mean_partitions_per_topic=ppt, replication_factor=rf,
+        distribution="exponential", seed=2026))
+    state = SeqState(model)
+    budget_s = float(os.environ.get("SEQ_BUDGET_S", "7200"))
+    t0 = time.monotonic()
+    prev = []
+    timed_out = False
+    for (name, kind, res, hard) in GOALS:
+        if kind == "topic_replica_distribution":
+            prev.append((name, kind, res, hard))  # veto-only (band follower)
+            continue
+        optimize_goal(state, name, kind, res, prev)
+        prev.append((name, kind, res, hard))
+        sys.stderr.write(f"{name}: wall={time.monotonic()-t0:.1f}s "
+                         f"actions={state.actions} "
+                         f"scored={state.plans_scored}\n")
+        if time.monotonic() - t0 > budget_s:
+            timed_out = True
+            break
+    wall = time.monotonic() - t0
+    hard_ok = all(state.goal_satisfied(n, k, r)
+                  for (n, k, r, h) in GOALS[:6])
+    print(json.dumps({
+        "scale": scale, "wall_s": round(wall, 2),
+        "plans_scored": state.plans_scored,
+        "plans_per_sec": round(state.plans_scored / max(wall, 1e-9), 1),
+        "actions": state.actions,
+        "hard_goals_satisfied": bool(hard_ok),
+        "timed_out": timed_out,
+        "method": "sequential greedy, reference semantics "
+                  "(AbstractGoal.java:224-266), NumPy, single CPU core",
+    }))
+
+
+if __name__ == "__main__":
+    main()
