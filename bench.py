@@ -139,7 +139,7 @@ def run_rung(scale: str, max_candidates, fast: bool) -> dict:
     plans_per_s = run.num_candidates_scored / max(wall_s, 1e-9)
     # North-star budget scaled to this rung's replica count.
     budget_s = 30.0 * num_replicas / 1_000_000
-    return {
+    rec = {
         "metric": f"wall_clock_to_goal_satisfying_proposal_{scale}",
         "value": round(wall_s, 3),
         "unit": "s",
@@ -152,6 +152,19 @@ def run_rung(scale: str, max_candidates, fast: bool) -> dict:
         "candidates_scored": run.num_candidates_scored,
         **({"fast_mode": True} if fast else {}),
     }
+    # Speedup over the sequential greedy baseline (the JVM-analyzer proxy:
+    # tools/sequential_baseline.py, run on the identical snapshot; the
+    # recorded SEQ_<scale>.json is produced by that script).
+    seq_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            f"SEQ_{scale}.json")
+    try:
+        with open(seq_path) as f:
+            seq = json.load(f)
+        rec["sequential_baseline_s"] = seq["wall_s"]
+        rec["vs_sequential"] = round(seq["wall_s"] / wall_s, 1)
+    except (OSError, KeyError, ValueError):
+        pass
+    return rec
 
 
 def main() -> None:

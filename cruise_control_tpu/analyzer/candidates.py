@@ -47,7 +47,14 @@ def default_num_sources(model: TensorClusterModel) -> int:
 
 
 def default_num_dests(model: TensorClusterModel) -> int:
-    return max(1, min(model.num_brokers, 32))
+    """Top-D destination brokers per step.  32 covers every rung up to a
+    few hundred brokers; beyond that the destination set must widen with
+    the fleet or it throttles throughput (at 7k brokers, 32 dests capped a
+    step at ~200 actions and the 1M-replica fixpoint at 192 steps never
+    converged — per-dest landings are bounded by the band budgets, so more
+    actions per step require more destinations)."""
+    b = model.num_brokers
+    return max(1, min(b, max(32, min(b // 8, 1024))))
 
 
 def move_candidates(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,

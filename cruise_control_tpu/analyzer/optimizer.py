@@ -153,9 +153,54 @@ def _channel_budgets(specs: Tuple[GoalSpec, ...], model: TensorClusterModel,
     metrics = _channel_metrics(model, arrays)
     upper_min = jnp.full((B, NUM_CHANNELS), jnp.inf, jnp.float32)
     lower_max = jnp.full((B, NUM_CHANNELS), -jnp.inf, jnp.float32)
+    # The resource-axis kinds are computed VECTORIZED over all four
+    # resources in one pass each (a per-spec limits() loop emitted ~6 small
+    # ops × up to 13 specs per step — pure serial op-chain cost on TPU);
+    # presence masks then select which channels actually constrain.
+    cap_channels = [s.resource for s in specs if s.kind == "capacity"]
+    if cap_channels:
+        thresh = jnp.asarray(constraint.capacity_threshold, jnp.float32)
+        upper_cap = arrays.capacity * thresh[None, :]              # [B, 4]
+        sel = np.zeros((NUM_CHANNELS,), bool)
+        sel[np.asarray(cap_channels)] = True
+        pad = jnp.full((B, 4), jnp.inf)
+        upper_min = jnp.minimum(
+            upper_min,
+            jnp.where(jnp.asarray(sel)[None, :],
+                      jnp.concatenate([upper_cap, pad], axis=1), jnp.inf))
+    dist_channels = [s.resource for s in specs
+                     if s.kind == "resource_distribution"]
+    if dist_channels:
+        bp = jnp.asarray([constraint.balance_percentage(r) for r in range(4)],
+                         jnp.float32)
+        alive_col = arrays.alive[:, None]
+        total_util = jnp.where(alive_col, arrays.load, 0.0).sum(axis=0)
+        total_cap = jnp.maximum(
+            jnp.where(alive_col, arrays.capacity, 0.0).sum(axis=0), 1e-9)
+        avg_pct = total_util / total_cap                            # [4]
+        low = jnp.asarray(constraint.low_utilization_threshold, jnp.float32)
+        gated = avg_pct <= low
+        # Mirrors kernels.limits' resource_distribution branch exactly
+        # (the _BIG sentinel under low-utilization gating included).
+        up_d = jnp.where(gated[None, :], kernels._BIG,
+                         avg_pct[None, :] * bp[None, :] * arrays.capacity)
+        lo_d = jnp.where(gated[None, :], 0.0,
+                         jnp.maximum(avg_pct[None, :] * (2.0 - bp)[None, :]
+                                     * arrays.capacity, 0.0))
+        sel = np.zeros((NUM_CHANNELS,), bool)
+        sel[np.asarray(dist_channels)] = True
+        pad = jnp.full((B, 4), jnp.inf)
+        upper_min = jnp.minimum(
+            upper_min, jnp.where(jnp.asarray(sel)[None, :],
+                                 jnp.concatenate([up_d, pad], axis=1),
+                                 jnp.inf))
+        lower_max = jnp.maximum(
+            lower_max, jnp.where(jnp.asarray(sel)[None, :],
+                                 jnp.concatenate([lo_d, -pad], axis=1),
+                                 -jnp.inf))
     for spec in specs:
         ch = _spec_channel(spec)
-        if ch is None:
+        if ch is None or spec.kind in ("capacity", "resource_distribution"):
             continue
         lo, up = kernels.limits(spec, model, arrays, constraint)
         upper_min = upper_min.at[:, ch].min(up)
@@ -557,7 +602,10 @@ def _goal_step(model: TensorClusterModel, options: OptimizationOptions,
     # so rounds = ceil(moves_per_broker_step / subrounds).  Lanes are nearly
     # free (same op count, bigger segment space); serial rounds are not —
     # prefer wide lanes over many rounds.
-    subrounds = SUBROUNDS
+    # moves.per.step remains the hard per-broker cap: lanes never exceed it
+    # (128 lanes of one round for the default; a throttled config gets
+    # exactly its configured width).
+    subrounds = min(SUBROUNDS, max(1, int(constraint.moves_per_broker_step)))
     rounds = max(1, -(-int(constraint.moves_per_broker_step) // subrounds))
     if _DBG_TRIVIAL_SELECT:
         keep = _best_per_segment(score, jnp.zeros(cand.k, jnp.int32), 1, eligible)

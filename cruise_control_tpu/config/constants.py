@@ -134,7 +134,7 @@ def analyzer_config_def() -> ConfigDef:
              group="analyzer")
     d.define(MAX_OPTIMIZER_STEPS_CONFIG, Type.INT, 4096, Range.at_least(1), Importance.MEDIUM,
              doc="Upper bound on batched greedy steps per goal.", group="analyzer")
-    d.define(MOVES_PER_STEP_CONFIG, Type.INT, 48, Range.at_least(1), Importance.MEDIUM,
+    d.define(MOVES_PER_STEP_CONFIG, Type.INT, 128, Range.at_least(1), Importance.MEDIUM,
              doc="Max actions one broker may participate in per batched step "
                  "(selection rounds x subround lanes).", group="analyzer")
     d.define(FAST_MODE_PER_BROKER_MOVE_TIMEOUT_MS_CONFIG, Type.LONG, 500, Range.at_least(1),

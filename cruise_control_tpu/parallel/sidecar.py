@@ -15,18 +15,25 @@ format as a stub-generated service.
 
 from __future__ import annotations
 
-import os
 import sys
+import threading
 from concurrent import futures
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-import analyzer_service_pb2 as pb  # noqa: E402  (protoc output, flat import)
+from cruise_control_tpu.parallel import analyzer_service_pb2 as pb
 
 SERVICE = "cruise_control_tpu.AnalyzerService"
 OPTIMIZE = "Optimize"
+
+# Concurrent optimizations admitted per process: device executions
+# serialize on the chip anyway, so queuing more than a couple only
+# multiplies peak host memory.  Requests beyond the limit wait up to
+# ADMISSION_TIMEOUT_S then fail fast with OVERLOADED.
+MAX_CONCURRENT_OPTIMIZATIONS = 2
+ADMISSION_TIMEOUT_S = 30.0
+_admission = threading.BoundedSemaphore(MAX_CONCURRENT_OPTIMIZATIONS)
 
 
 def model_to_proto(model) -> pb.ClusterModelProto:
@@ -52,8 +59,41 @@ def model_to_proto(model) -> pb.ClusterModelProto:
     )
 
 
+class InvalidModelError(ValueError):
+    pass
+
+
+def _validate_proto(proto: pb.ClusterModelProto) -> None:
+    """Wire-shape validation: every axis consistent before any device work
+    (INVALID_MODEL beats a shape error deep inside jit)."""
+    R = len(proto.replica_broker)
+    B = len(proto.broker_rack)
+    if R == 0 or B == 0:
+        raise InvalidModelError(f"empty model (R={R}, B={B})")
+    for name in ("replica_partition", "replica_topic", "replica_is_leader"):
+        if len(getattr(proto, name)) != R:
+            raise InvalidModelError(
+                f"{name} has {len(getattr(proto, name))} rows, expected {R}")
+    for name in ("replica_load_leader", "replica_load_follower"):
+        if len(getattr(proto, name)) != R * 4:
+            raise InvalidModelError(
+                f"{name} has {len(getattr(proto, name))} floats, "
+                f"expected R*4={R * 4}")
+    if len(proto.broker_capacity) != B * 4:
+        raise InvalidModelError(
+            f"broker_capacity has {len(proto.broker_capacity)} floats, "
+            f"expected B*4={B * 4}")
+    if len(proto.broker_state) != B:
+        raise InvalidModelError(
+            f"broker_state has {len(proto.broker_state)} rows, expected {B}")
+    rb = np.asarray(proto.replica_broker)
+    if rb.min(initial=0) < 0 or rb.max(initial=0) >= B:
+        raise InvalidModelError("replica_broker ids out of [0, B)")
+
+
 def proto_to_model(proto: pb.ClusterModelProto):
     from cruise_control_tpu.model.tensor_model import build_model
+    _validate_proto(proto)
     R = len(proto.replica_broker)
     B = len(proto.broker_rack)
     return build_model(
@@ -72,22 +112,46 @@ def proto_to_model(proto: pb.ClusterModelProto):
     )
 
 
-def _optimize(request: pb.OptimizeRequest) -> pb.OptimizeResponse:
+def _optimize(request: pb.OptimizeRequest,
+              context=None) -> pb.OptimizeResponse:
     from cruise_control_tpu.analyzer import optimizer as opt
     from cruise_control_tpu.analyzer import proposals as props
     from cruise_control_tpu.analyzer.goals.specs import DEFAULT_GOAL_ORDER
 
+    # Admission: bounded concurrency with a fail-fast queue (requests
+    # arriving while the chip is saturated get OVERLOADED instead of
+    # stacking model copies in host memory until the deadline).
+    if not _admission.acquire(timeout=ADMISSION_TIMEOUT_S):
+        return pb.OptimizeResponse(
+            error=f"server over capacity "
+                  f"({MAX_CONCURRENT_OPTIMIZATIONS} optimizations in flight)",
+            error_code=pb.OVERLOADED)
     try:
-        model = proto_to_model(request.model)
-        goals = list(request.goals) or list(DEFAULT_GOAL_ORDER)
-        run = opt.optimize(
-            model, goals,
-            max_steps_per_goal=request.max_steps_per_goal or 256,
-            raise_on_hard_failure=False, fused=True,
-            fast_mode=request.fast_mode)
-        diff = props.diff(model, run.model)
-    except Exception as e:  # noqa: BLE001 — errors cross the wire as payload
-        return pb.OptimizeResponse(error=f"{type(e).__name__}: {e}")
+        if context is not None and not context.is_active():
+            # Client gave up while we queued — don't burn the chip.
+            return pb.OptimizeResponse(error="client cancelled while queued",
+                                       error_code=pb.OVERLOADED)
+        try:
+            model = proto_to_model(request.model)
+        except InvalidModelError as e:
+            return pb.OptimizeResponse(error=str(e),
+                                       error_code=pb.INVALID_MODEL)
+        try:
+            goals = list(request.goals) or list(DEFAULT_GOAL_ORDER)
+            run = opt.optimize(
+                model, goals,
+                max_steps_per_goal=request.max_steps_per_goal or 256,
+                raise_on_hard_failure=False, fused=True,
+                fast_mode=request.fast_mode)
+            diff = props.diff(model, run.model)
+        except opt.OptimizationFailureException as e:
+            return pb.OptimizeResponse(error=str(e),
+                                       error_code=pb.OPTIMIZATION_FAILED)
+        except Exception as e:  # noqa: BLE001 — crosses the wire as payload
+            return pb.OptimizeResponse(error=f"{type(e).__name__}: {e}",
+                                       error_code=pb.INTERNAL)
+    finally:
+        _admission.release()
     return pb.OptimizeResponse(
         goal_results=[pb.GoalResultProto(
             name=g.name, is_hard=g.is_hard,
@@ -112,7 +176,7 @@ def serve_sidecar(port: int = 0, max_workers: int = 4):
 
     handler = grpc.method_handlers_generic_handler(SERVICE, {
         OPTIMIZE: grpc.unary_unary_rpc_method_handler(
-            lambda req, ctx: _optimize(req),
+            _optimize,
             request_deserializer=pb.OptimizeRequest.FromString,
             response_serializer=pb.OptimizeResponse.SerializeToString),
     })
@@ -138,6 +202,9 @@ class AnalyzerClient:
                  goals: Sequence[str] = (), fast_mode: bool = False,
                  max_steps_per_goal: int = 0,
                  timeout_s: float = 600.0) -> pb.OptimizeResponse:
+        """One optimization round trip.  ``timeout_s`` is a hard gRPC
+        deadline — the server observes cancellation while queued, so a
+        departed client never consumes chip time."""
         return self._optimize(
             pb.OptimizeRequest(model=model_proto, goals=list(goals),
                                fast_mode=fast_mode,

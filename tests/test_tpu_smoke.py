@@ -1,0 +1,132 @@
+"""Opt-in TPU smoke lane (round-3 verdict task 7): ~5 core probes of the
+TPU-only code paths that the CPU suite can't see (per-goal chunking,
+segmented fixpoints, packed transfers) so TPU-path breakage surfaces
+before the end-of-round bench.
+
+Run with ``python -m pytest tests/test_tpu_smoke.py -m tpu`` on a machine
+with the tunneled chip; skipped (quickly) when the backend doesn't come up
+within ``TPU_SMOKE_INIT_TIMEOUT_S`` (default 120 s).  The suite's conftest
+pins the parent process to CPU, so the probes run in ONE subprocess with a
+clean JAX config and report one JSON line per probe.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_INIT_TIMEOUT_S = float(os.environ.get("TPU_SMOKE_INIT_TIMEOUT_S", "120"))
+_RUN_TIMEOUT_S = float(os.environ.get("TPU_SMOKE_RUN_TIMEOUT_S", "900"))
+
+_PROBE_SCRIPT = r"""
+import json, sys, threading, os
+
+def _watchdog():
+    print(json.dumps({"probe": "backend", "ok": False,
+                      "error": "backend init timeout"}), flush=True)
+    os._exit(3)
+
+t = threading.Timer(%INIT%, _watchdog)
+t.daemon = True
+t.start()
+import jax
+platform = jax.devices()[0].platform
+t.cancel()
+print(json.dumps({"probe": "backend", "ok": platform == "tpu",
+                  "platform": platform}), flush=True)
+
+import jax.numpy as jnp
+from cruise_control_tpu.analyzer import optimizer as opt
+from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster
+from cruise_control_tpu.analyzer.balancing_constraint import BalancingConstraint
+from cruise_control_tpu.analyzer.goals.specs import goals_by_priority
+from cruise_control_tpu.analyzer.state import OptimizationOptions
+
+model = generate_cluster(ClusterSpec(num_brokers=8, num_racks=4, num_topics=6,
+                                     mean_partitions_per_topic=20.0,
+                                     replication_factor=2,
+                                     distribution="exponential", seed=5))
+model = jax.device_put(model)
+options = OptimizationOptions.none(model)
+constraint = BalancingConstraint.default()
+
+# Probe 1: one goal's device-resident fixpoint.
+spec = goals_by_priority(["ReplicaDistributionGoal"])[0]
+fn = opt._get_fixpoint_fn(spec, (), constraint, 64, 8, max_steps=64)
+out = fn(model, options)
+jax.block_until_ready(out[0])
+print(json.dumps({"probe": "goal_fixpoint", "ok": bool(out[4]),
+                  "steps": int(out[1])}), flush=True)
+
+# Probe 2: chunked dispatch (per-goal programs, acceptance context carried).
+run = opt.optimize(model, ["RackAwareGoal", "ReplicaCapacityGoal",
+                           "ReplicaDistributionGoal"],
+                   raise_on_hard_failure=False, fused=True, fuse_group_size=1)
+print(json.dumps({"probe": "chunked_dispatch",
+                  "ok": all(g.satisfied_after for g in run.goal_results
+                            if g.is_hard)}), flush=True)
+
+# Probe 3: segmented fixpoint (bounded dispatches, state carried across).
+run = opt.optimize(model, ["ReplicaDistributionGoal"],
+                   raise_on_hard_failure=False, fused=True, segment_steps=4)
+print(json.dumps({"probe": "segmented_fixpoint",
+                  "ok": all(g.satisfied_after for g in run.goal_results)}),
+      flush=True)
+
+# Probe 4: packed transfer (one i32[5, G] fetch for a whole stack run).
+stack = tuple(goals_by_priority(["RackAwareGoal", "ReplicaDistributionGoal"]))
+stack_fn = opt._get_stack_fn(stack, constraint, 64, 8, 64)
+m2, packed = stack_fn(model, options)
+packed_host = jax.device_get(packed)
+print(json.dumps({"probe": "packed_transfer",
+                  "ok": packed_host.shape == (5, 2)}), flush=True)
+
+# Probe 5: full small-stack optimize end to end on the chip.
+from bench import STACK
+run = opt.optimize(model, STACK, raise_on_hard_failure=False, fused=True)
+print(json.dumps({"probe": "full_stack",
+                  "ok": all(g.satisfied_after for g in run.goal_results
+                            if g.is_hard)}), flush=True)
+"""
+
+
+@pytest.fixture(scope="module")
+def tpu_probe_results():
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    script = _PROBE_SCRIPT.replace("%INIT%", str(_INIT_TIMEOUT_S))
+    try:
+        proc = subprocess.run([sys.executable, "-c", script], cwd=_REPO,
+                              env=env, capture_output=True, text=True,
+                              timeout=_RUN_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        pytest.skip("TPU smoke subprocess timed out (wedged tunnel?)")
+    results = {}
+    for line in proc.stdout.splitlines():
+        try:
+            rec = json.loads(line)
+            results[rec["probe"]] = rec
+        except (ValueError, KeyError):
+            continue
+    backend = results.get("backend", {})
+    if not backend.get("ok"):
+        pytest.skip(f"TPU backend unavailable: {backend} "
+                    f"(stderr tail: {proc.stderr[-300:]!r})")
+    if proc.returncode != 0:
+        pytest.fail(f"TPU probe subprocess rc={proc.returncode}; "
+                    f"stderr tail: {proc.stderr[-2000:]}")
+    return results
+
+
+@pytest.mark.parametrize("probe", ["goal_fixpoint", "chunked_dispatch",
+                                   "segmented_fixpoint", "packed_transfer",
+                                   "full_stack"])
+def test_tpu_probe(tpu_probe_results, probe):
+    rec = tpu_probe_results.get(probe)
+    assert rec is not None, f"probe {probe} produced no result"
+    assert rec.get("ok"), rec

@@ -3,7 +3,7 @@ gathers, per-step kernel bodies) — the distilled survivors of round-3's
 ad-hoc `_profile_*` scripts.  Times N iterations INSIDE one jit
 (fori_loop with a data dependency) so tunnel/dispatch overhead is excluded.
 
-Usage: python tools/profile_microbench.py [R B P K N]
+Usage: python tools/profile_microbench.py [R [B [K [N]]]]
 """
 import sys
 import time
@@ -11,10 +11,9 @@ import time
 import jax
 import jax.numpy as jnp
 
-R, B, P, K = 10240, 56, 3400, 20800
-N = 300
-if len(sys.argv) > 1:
-    R, B, P, K, N = (int(a) for a in sys.argv[1:6])
+R, B, K, N = 10240, 56, 20800, 300
+args = [int(a) for a in sys.argv[1:5]]
+R, B, K, N = args + [R, B, K, N][len(args):]
 
 key = jax.random.PRNGKey(0)
 vals = jax.random.normal(key, (R,))

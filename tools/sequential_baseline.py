@@ -262,9 +262,7 @@ def optimize_goal(state, name, kind, res, prev):
             replicas = np.nonzero(state.valid & (state.rb == src))[0]
             rload = state.rload()
             if kind == "rack":
-                mask = np.array([(state.sibling_brokers(r) ==
-                                  state.rack[state.rb[r]]).any() or
-                                 (state.rack[state.sibling_brokers(r)] ==
+                mask = np.array([(state.rack[state.sibling_brokers(r)] ==
                                   state.rack[src]).any()
                                  for r in replicas])
                 replicas = replicas[mask] if mask.size else replicas[:0]
@@ -345,6 +343,9 @@ def main():
             timed_out = True
             break
     wall = time.monotonic() - t0
+    goal_sat = {n: state.goal_satisfied(n, k, r)
+                for (n, k, r, h) in GOALS
+                if k != "topic_replica_distribution"}
     hard_ok = all(state.goal_satisfied(n, k, r)
                   for (n, k, r, h) in GOALS[:6])
     print(json.dumps({
@@ -353,6 +354,7 @@ def main():
         "plans_per_sec": round(state.plans_scored / max(wall, 1e-9), 1),
         "actions": state.actions,
         "hard_goals_satisfied": bool(hard_ok),
+        "goal_satisfied": {k: bool(v) for k, v in goal_sat.items()},
         "timed_out": timed_out,
         "method": "sequential greedy, reference semantics "
                   "(AbstractGoal.java:224-266), NumPy, single CPU core",
