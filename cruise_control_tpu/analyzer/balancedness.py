@@ -45,10 +45,16 @@ def balancedness_cost_by_goal(goals: Sequence, priority_weight: float = DEFAULT_
         raise ValueError(
             f"balancedness weights must be positive "
             f"(priority:{priority_weight}, strictness:{strictness_weight})")
+    # Dedupe by name, keeping the highest-priority occurrence (duplicated
+    # request goals would otherwise inflate weight_sum while the dict keeps
+    # one entry, deflating every normalized cost).
+    seen = set()
+    unique = [g for g in goals
+              if not (g.name in seen or seen.add(g.name))]
     costs: Dict[str, float] = {}
     weight_sum = 0.0
     prev_priority_weight = 1.0 / priority_weight
-    for spec in reversed(list(goals)):  # lowest priority first
+    for spec in reversed(unique):  # lowest priority first
         current = priority_weight * prev_priority_weight
         cost = current * (strictness_weight if spec.is_hard else 1.0)
         weight_sum += cost

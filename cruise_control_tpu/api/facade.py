@@ -338,7 +338,10 @@ class CruiseControl:
                   rebalance_disk: bool = False,
                   self_healing: bool = False) -> OperationResult:
         model, naming = self._model_naming()
-        if goals:
+        if goals and not self_healing:
+            # Self-healing fixes run detection goals, which an operator may
+            # configure beyond the request-facing goals= set — internal
+            # stacks are not gated (see _validate_goals).
             self._validate_goals(goals)
         options = self._base_options(model, naming)
         if destination_broker_ids:

@@ -112,7 +112,8 @@ def build_parser() -> argparse.ArgumentParser:
            ("--review_id", dict(type=int))]
     add("rebalance", "POST", "rebalance the cluster",
         mut + [("--goals", dict()), ("--destination_broker_ids", dict()),
-               ("--fast_mode", dict(action="store_true"))])
+               ("--fast_mode", dict(action="store_true")),
+               ("--rebalance_disk", dict(action="store_true"))])
     add("add_broker", "POST", "move load onto new brokers",
         mut + [("--brokerid", dict(required=True))])
     add("remove_broker", "POST", "decommission brokers",
