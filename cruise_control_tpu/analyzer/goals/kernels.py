@@ -389,8 +389,12 @@ def self_feasible(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArray
     d_src, d_dest = _candidate_deltas(spec, cand)
     src_m, dest_m = metric[cand.src], metric[cand.dest]
     src_after, dest_after = src_m + d_src, dest_m + d_dest
-    src_over = src_m > upper[cand.src]
-    dest_under = dest_m < lower[cand.dest]
+    # The same epsilon tolerance as goal_satisfied/violated_brokers: a goal
+    # that reads satisfied must have an EMPTY feasible set (the fixpoint's
+    # satisfied-skip shortcut relies on that invariant exactly).
+    eps = _metric_epsilon(spec)
+    src_over = src_m > upper[cand.src] + eps
+    dest_under = dest_m < lower[cand.dest] - eps
     helps = src_over | dest_under | unhealthy
     dest_ok = dest_after <= upper[cand.dest]
     src_ok = (src_after >= lower[cand.src]) | unhealthy
@@ -424,8 +428,11 @@ def _intra_disk_feasible(spec: GoalSpec, model: TensorClusterModel,
     d = jnp.maximum(cand.dest_disk, 0)
     contrib = model.replica_load()[cand.replica, Resource.DISK]
     src_dead = model.disk_capacity[s] < 0.0
-    src_over = disk_load[s] > up_d[s]
-    dest_under = disk_load[d] < lo_d[d]
+    # Same epsilon as goal_satisfied: satisfied ⇒ empty feasible set (the
+    # fixpoint's satisfied-skip relies on it).
+    eps = _metric_epsilon(spec)
+    src_over = disk_load[s] > up_d[s] + eps
+    dest_under = disk_load[d] < lo_d[d] - eps
     helps = src_over | dest_under | src_dead
     same_broker = model.disk_broker[d] == cand.src
     valid_disks = (cand.src_disk >= 0) & (cand.dest_disk >= 0) & \
