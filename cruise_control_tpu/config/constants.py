@@ -331,6 +331,9 @@ SELF_HEALING_EXCLUDE_RECENTLY_REMOVED_BROKERS_CONFIG = "self.healing.exclude.rec
 TOPIC_ANOMALY_FINDER_CLASSES_CONFIG = "topic.anomaly.finder.class"
 SELF_HEALING_PARTITION_SIZE_THRESHOLD_MB_CONFIG = \
     "self.healing.partition.size.threshold.mb"
+METRIC_ANOMALY_PERCENTILE_UPPER_THRESHOLD_CONFIG = \
+    "metric.anomaly.percentile.upper.threshold"
+METRIC_ANOMALY_UPPER_MARGIN_CONFIG = "metric.anomaly.upper.margin"
 SELF_HEALING_TARGET_TOPIC_REPLICATION_FACTOR_CONFIG = "self.healing.target.topic.replication.factor"
 PROVISIONER_CLASS_CONFIG = "provisioner.class"
 NUM_CACHED_RECENT_ANOMALY_STATES_CONFIG = "num.cached.recent.anomaly.states"
@@ -392,6 +395,14 @@ def anomaly_detector_config_def() -> ConfigDef:
              importance=Importance.LOW,
              doc="Partitions larger than this are reported as topic anomalies "
                  "(PartitionSizeAnomalyFinder; inf disables).", group="detector")
+    d.define(METRIC_ANOMALY_PERCENTILE_UPPER_THRESHOLD_CONFIG, Type.DOUBLE, 95.0,
+             Range.between(0.0, 100.0), Importance.LOW,
+             doc="Percentile of a broker's own metric history anchoring the "
+                 "percentile anomaly finder.", group="detector")
+    d.define(METRIC_ANOMALY_UPPER_MARGIN_CONFIG, Type.DOUBLE, 0.5, Range.at_least(0.0),
+             Importance.LOW,
+             doc="Fractional margin over the history percentile before a "
+                 "metric counts as anomalous.", group="detector")
     d.define(PROVISIONER_CLASS_CONFIG, Type.STRING,
              "cruise_control_tpu.detector.provisioner.NoopProvisioner",
              importance=Importance.LOW, doc="Provisioner (rightsizing) plugin.", group="detector")

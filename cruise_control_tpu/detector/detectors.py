@@ -197,11 +197,34 @@ class PercentileMetricAnomalyFinder:
     brokers whose latest value exceeds the upper percentile of their own
     history by a margin."""
 
-    def __init__(self, metric_name: str, upper_percentile: float = 95.0,
-                 margin: float = 1.0):
+    def __init__(self, metric_name: str = "BROKER_LOG_FLUSH_TIME_MS_999TH",
+                 upper_percentile: float = 95.0, margin: float = 1.5,
+                 persistence: int = 1):
+        # The default metric matches the reference's slow-broker signal so
+        # the class is loadable via metric.anomaly.finder.class.  The 1.5x
+        # default margin = the reference's metric.anomaly.upper.margin=0.5
+        # over the history percentile.
         self.metric = metric_name
         self._pct = upper_percentile
         self._margin = margin
+        # Optional: consecutive excursions required before reporting
+        # (reference parity is 1 — report on detection; raise for noisy
+        # metrics, noting an excursion folds into its own history next
+        # window).
+        self._persistence = persistence
+        self._streak: Dict[int, int] = {}
+
+    def configure(self, config: Dict[str, object]) -> None:
+        """Plugin-style init (metric.anomaly.finder.class): the reference's
+        PercentileMetricAnomalyFinderConfig keys — upper percentile and the
+        fractional upper margin (threshold = percentile x (1 + margin))."""
+        from cruise_control_tpu.config import constants as C
+        if C.METRIC_ANOMALY_PERCENTILE_UPPER_THRESHOLD_CONFIG in config:
+            self._pct = float(
+                config[C.METRIC_ANOMALY_PERCENTILE_UPPER_THRESHOLD_CONFIG])
+        if C.METRIC_ANOMALY_UPPER_MARGIN_CONFIG in config:
+            self._margin = 1.0 + float(
+                config[C.METRIC_ANOMALY_UPPER_MARGIN_CONFIG])
 
     def anomalies(self, broker_agg) -> Dict[int, float]:
         res = broker_agg.aggregate()
@@ -219,6 +242,34 @@ class PercentileMetricAnomalyFinder:
             if latest > threshold and latest > 0:
                 out[broker] = float(latest / max(threshold, 1e-9))
         return out
+
+    def detect(self, broker_agg, now_ms: int) -> Optional[SlowBrokers]:
+        """Finder SPI (metric.anomaly.finder.class): persistent percentile
+        excursions surface as a demote-class metric anomaly carrying the
+        excursion ratio as the score.  Guards mirror SlowBrokerFinder's:
+        a broker must exceed its threshold on ``persistence`` consecutive
+        passes, and a systemic event (more than half the brokers excursive
+        at once — a cluster-wide load spike, not per-broker slowness)
+        reports nothing."""
+        found = self.anomalies(broker_agg)
+        for b in list(self._streak):
+            if b not in found:
+                del self._streak[b]
+        for b in found:
+            self._streak[b] = self._streak.get(b, 0) + 1
+        num_brokers = len(broker_agg.aggregate().entities)
+        # Systemic guard (SlowBrokerFinder semantics): when most of a
+        # non-trivial cluster looks anomalous at once it's a workload
+        # event, not broker sickness — self-healing must not demote half
+        # the fleet.
+        if num_brokers >= 4 and len(found) > num_brokers // 2:
+            return None
+        persistent = {b: found[b] for b, n in self._streak.items()
+                      if n >= self._persistence and b in found}
+        if not persistent:
+            return None
+        return SlowBrokers(detection_time_ms=now_ms, slow_brokers=persistent,
+                           fix_by_removal=False)
 
 
 class SlowBrokerFinder:
