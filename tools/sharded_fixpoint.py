@@ -1,0 +1,214 @@
+"""Uncapped full-stack fixpoint at 7k brokers / ~1M replicas, chunked + resumable.
+
+Round-5 successor to ``sharded_1m.py``: runs every goal of the default
+stack to a TRUE fixpoint (the reference's per-goal ``while (!finished)``
+semantics, AbstractGoal.java:98-119 — no step cap), by invoking the
+device-resident fixpoint in bounded chunks and re-invoking while the
+chunk reports ``capped``.  Between chunks the mutable model state
+(replica_broker / replica_is_leader / replica_disk) is checkpointed to
+disk so a multi-hour virtual-CPU-mesh run survives interruption and
+resumes goal- and chunk-exactly.  Each chunk's accepted-action count is
+recorded, giving the actions/step decay curve per goal.
+
+Usage:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/sharded_fixpoint.py
+Environment:
+    FIXPOINT_CHUNK      steps per chunk (default 32)
+    FIXPOINT_MAX_CHUNKS safety valve per goal (default 64 -> 2048 steps)
+    FIXPOINT_STATE      checkpoint dir (default <repo>/.fixpoint_state)
+    SHARDED_OUT         final record path (default SHARDED_1M_r05.json)
+    SHARDED_GOALS / SHARDED_NS / SHARDED_ND as in sharded_1m.py
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+STACK = [
+    "RackAwareGoal", "ReplicaCapacityGoal", "DiskCapacityGoal",
+    "NetworkInboundCapacityGoal", "NetworkOutboundCapacityGoal", "CpuCapacityGoal",
+    "ReplicaDistributionGoal", "PotentialNwOutGoal", "DiskUsageDistributionGoal",
+    "NetworkInboundUsageDistributionGoal", "NetworkOutboundUsageDistributionGoal",
+    "CpuUsageDistributionGoal", "TopicReplicaDistributionGoal",
+    "LeaderReplicaDistributionGoal", "LeaderBytesInDistributionGoal",
+]
+
+
+def main():
+    import jax
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from cruise_control_tpu.analyzer import candidates as cgen
+    from cruise_control_tpu.analyzer import optimizer as opt
+    from cruise_control_tpu.analyzer import proposals as props
+    from cruise_control_tpu.analyzer.balancing_constraint import BalancingConstraint
+    from cruise_control_tpu.analyzer.goals.specs import goals_by_priority
+    from cruise_control_tpu.analyzer.state import OptimizationOptions
+    from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster
+    from cruise_control_tpu.parallel import mesh as pmesh
+
+    state_dir = os.environ.get("FIXPOINT_STATE",
+                               os.path.join(REPO, ".fixpoint_state"))
+    os.makedirs(state_dir, exist_ok=True)
+    ckpt_path = os.path.join(state_dir, "model.npz")
+    prog_path = os.path.join(state_dir, "progress.json")
+    partial_path = os.path.join(REPO, "SHARDED_1M_r05.partial.json")
+    out_path = os.environ.get("SHARDED_OUT",
+                              os.path.join(REPO, "SHARDED_1M_r05.json"))
+
+    devs = jax.devices()
+    n = len(devs)
+    t_total = time.monotonic()
+    nb = int(os.environ.get("FIXPOINT_BROKERS", "7000"))
+    nt = int(os.environ.get("FIXPOINT_TOPICS", "200"))
+    mppt = float(os.environ.get("FIXPOINT_MPPT", "1667.0"))
+    spec = ClusterSpec(num_brokers=nb, num_racks=max(2, nb // 100),
+                       num_topics=nt, mean_partitions_per_topic=mppt,
+                       replication_factor=3,
+                       distribution="exponential", seed=2026)
+    model0 = generate_cluster(spec, pad_replicas_to_multiple=n)
+    num_replicas = int(np.asarray(model0.replica_valid).sum())
+    print(f"model built: B={nb} R={num_replicas} "
+          f"({time.monotonic() - t_total:.1f}s), mesh={n} device(s)",
+          flush=True)
+
+    progress = {"completed": [], "elapsed_s": 0.0}
+    model = model0
+    if os.path.exists(prog_path) and os.path.exists(ckpt_path):
+        with open(prog_path) as f:
+            progress = json.load(f)
+        ck = np.load(ckpt_path)
+        model = model0.replace(
+            replica_broker=ck["replica_broker"],
+            replica_is_leader=ck["replica_is_leader"],
+            replica_disk=ck["replica_disk"])
+        print(f"resumed: {len(progress['completed'])} goals done, "
+              f"{progress['elapsed_s']:.0f}s accumulated", flush=True)
+
+    mesh = Mesh(np.array(devs), (pmesh.SEARCH_AXIS,))
+    model = pmesh.shard_model_replica_axis(model, mesh)
+    jax.block_until_ready(model.replica_broker)
+    options = OptimizationOptions.none(model)
+    constraint = BalancingConstraint.default()
+
+    goal_names = [g for g in os.environ.get(
+        "SHARDED_GOALS", ",".join(STACK)).split(",") if g]
+    chunk = int(os.environ.get("FIXPOINT_CHUNK", "32"))
+    max_chunks = int(os.environ.get("FIXPOINT_MAX_CHUNKS", "64"))
+    ns = int(os.environ.get("SHARDED_NS", "0")) or cgen.default_num_sources(model)
+    nd = int(os.environ.get("SHARDED_ND", "0")) or cgen.default_num_dests(model)
+    print(f"stack={len(goal_names)} goals ns={ns} nd={nd} "
+          f"chunk={chunk} max_chunks={max_chunks}", flush=True)
+
+    def save_state(elapsed):
+        np.savez(ckpt_path + ".tmp.npz",
+                 replica_broker=np.asarray(model.replica_broker),
+                 replica_is_leader=np.asarray(model.replica_is_leader),
+                 replica_disk=np.asarray(model.replica_disk))
+        os.replace(ckpt_path + ".tmp.npz", ckpt_path)
+        progress["elapsed_s"] = elapsed
+        with open(prog_path + ".tmp", "w") as f:
+            json.dump(progress, f)
+        os.replace(prog_path + ".tmp", prog_path)
+        with open(partial_path, "w") as f:
+            json.dump({"metric": "sharded_1m_fixpoint_partial",
+                       "progress": progress}, f)
+
+    done_names = {g["name"] for g in progress["completed"]}
+    prev = ()
+    t_round = time.monotonic()
+    base_elapsed = progress["elapsed_s"]
+    for name in goal_names:
+        gspec = goals_by_priority([name])[0]
+        if name in done_names:
+            prev = prev + (gspec,)
+            continue
+        fix = opt._get_fixpoint_fn(gspec, prev, constraint, ns, nd,
+                                   chunk, mesh=mesh)
+        steps = actions = n_chunks = 0
+        before0 = None
+        chunks = []
+        capped = True
+        while capped and n_chunks < max_chunks:
+            t0 = time.monotonic()
+            out = fix(model, options)
+            jax.block_until_ready(out[0])
+            wall = time.monotonic() - t0
+            model = out[0]
+            s, a, b, aft, cap = (int(out[i]) for i in range(1, 6))
+            if before0 is None:
+                before0 = bool(b)
+            steps += s
+            actions += a
+            n_chunks += 1
+            capped = bool(cap)
+            chunks.append({"steps": s, "actions": a, "wall_s": round(wall, 1)})
+            elapsed = base_elapsed + (time.monotonic() - t_round)
+            print(f"{name} chunk {n_chunks}: steps={s} actions={a} "
+                  f"capped={capped} satisfied={bool(aft)} "
+                  f"wall={wall:.0f}s total={elapsed:.0f}s", flush=True)
+            save_state(elapsed)
+        entry = {
+            "name": name, "steps": steps, "actions": actions,
+            "satisfied_before": before0, "satisfied_after": bool(aft),
+            "capped": bool(capped),  # only true if max_chunks safety tripped
+            "chunks": chunks,
+            "wall_s": round(sum(c["wall_s"] for c in chunks), 1),
+        }
+        progress["completed"].append(entry)
+        prev = prev + (gspec,)
+        save_state(base_elapsed + (time.monotonic() - t_round))
+        print(f"{name} DONE: steps={steps} actions={actions} "
+              f"satisfied={entry['satisfied_after']} capped={capped}", flush=True)
+
+    # ---- final verification + record --------------------------------
+    t0 = time.monotonic()
+    proposals = props.diff(model0, model)
+    diff_s = time.monotonic() - t0
+
+    # Invariants (analyzer/verifier.py semantics, run inline because this
+    # drive bypasses OptimizerRun): sanity, RF preservation, valid masks.
+    model.sanity_check()
+    rf0 = np.asarray(model0.partition_replication_factor())
+    rf1 = np.asarray(model.partition_replication_factor())
+    assert (rf0 == rf1).all(), "replication factor changed"
+    assert (np.asarray(model0.replica_valid)
+            == np.asarray(model.replica_valid)).all(), "valid mask changed"
+
+    per_goal = {g["name"]: {k: g[k] for k in
+                            ("steps", "actions", "satisfied_before",
+                             "satisfied_after", "capped", "chunks", "wall_s")}
+                for g in progress["completed"]}
+    hard = {g.name for g in goals_by_priority(goal_names) if g.is_hard}
+    hard_ok = all(per_goal[g]["satisfied_after"] for g in per_goal if g in hard)
+    record = {
+        "metric": "sharded_1m_fixpoint",
+        "num_replicas": num_replicas,
+        "num_brokers": nb,
+        "devices": n,
+        "backend": devs[0].platform,
+        "optimize_wall_s": round(progress["elapsed_s"], 1),
+        "proposal_diff_s": round(diff_s, 1),
+        "total_steps": sum(g["steps"] for g in per_goal.values()),
+        "num_proposals": len(proposals),
+        "hard_goals_satisfied": bool(hard_ok),
+        "uncapped": all(not g["capped"] for g in per_goal.values()),
+        "invariants_verified": True,
+        "per_goal": per_goal,
+    }
+    with open(out_path, "w") as f:
+        f.write(json.dumps(record) + "\n")
+    print(json.dumps({k: v for k, v in record.items() if k != "per_goal"}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
