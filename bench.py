@@ -150,6 +150,15 @@ def run_rung(scale: str, max_candidates, fast: bool) -> dict:
         "num_proposals": len(proposals),
         "hard_goals_satisfied": hard_ok,
         "candidates_scored": run.num_candidates_scored,
+        # Per-goal steps/actions/wall/capped so a step-count regression in
+        # one goal is visible round-over-round (the reference records
+        # per-goal durations in every OptimizerResult,
+        # GoalOptimizer.java:446-450).
+        "per_goal": {g.name: {
+            "steps": g.steps, "actions": g.actions_applied,
+            "wall_s": round(g.duration_s, 3), "capped": g.capped,
+            "satisfied_after": g.satisfied_after,
+        } for g in run.goal_results},
         **({"fast_mode": True} if fast else {}),
     }
     # Speedup over the sequential greedy baseline (the JVM-analyzer proxy:

@@ -825,7 +825,8 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
              max_candidates_per_step: Optional[int] = None,
              segment_steps: Optional[int] = None,
              balancedness_priority_weight: float = 1.1,
-             balancedness_strictness_weight: float = 1.5) -> OptimizerRun:
+             balancedness_strictness_weight: float = 1.5,
+             mesh=None) -> OptimizerRun:
     """Run the goal stack in priority order (GoalOptimizer.optimizations).
 
     Each goal optimizes the model to its fixpoint, constrained by the
@@ -847,6 +848,12 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
     search time, BalancingConstraint.java:36 /
     ResourceDistributionGoal.java:475-479): narrower candidate batches and
     a quarter of the step budget per goal.
+
+    ``mesh`` runs every goal program through the GSPMD sharded path
+    (parallel/mesh.py): pass a model already sharded with
+    ``shard_model_replica_axis`` and the same ``jax.sharding.Mesh`` — the
+    orchestration (chunking, segmenting, acceptance context, results) is
+    identical to the single-device path.
     """
     constraint = constraint or BalancingConstraint.default()
     options = options if options is not None else OptimizationOptions.none(model)
@@ -927,7 +934,7 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
                 while remaining > 0:
                     seg = min(segment_steps, remaining)
                     stack_fn = _get_stack_fn(chunk, constraint, ns, nd, seg,
-                                             prev_specs=prev)
+                                             mesh=mesh, prev_specs=prev)
                     model, packed = stack_fn(model, options)
                     row = jax.device_get(packed)[:, 0]
                     steps_t += int(row[0])
@@ -944,7 +951,8 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
                     np.int64))
             else:
                 stack_fn = _get_stack_fn(chunk, constraint, ns, nd,
-                                         max_steps_per_goal, prev_specs=prev)
+                                         max_steps_per_goal, mesh=mesh,
+                                         prev_specs=prev)
                 model, packed = stack_fn(model, options)
                 packed_rows.append(packed)
             prev = prev + chunk
@@ -967,7 +975,7 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
         for spec in specs:
             t0 = time.monotonic()
             fixpoint = _get_fixpoint_fn(spec, prev, constraint, ns, nd,
-                                        max_steps_per_goal)
+                                        max_steps_per_goal, mesh=mesh)
             model, steps_d, actions_d, before_d, after_d, capped_d = \
                 fixpoint(model, options)
             steps, actions = int(steps_d), int(actions_d)

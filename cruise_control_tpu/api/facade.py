@@ -38,6 +38,7 @@ from cruise_control_tpu.analyzer.state import OptimizationOptions
 from cruise_control_tpu.analyzer.verifier import VerificationError, verify_run
 from cruise_control_tpu.executor.admin import ClusterAdmin, ReassignmentRequest
 from cruise_control_tpu.executor.executor import Executor, OngoingExecutionError
+from cruise_control_tpu.executor.strategy import resolve_strategy
 from cruise_control_tpu.model.stats import compute_stats
 from cruise_control_tpu.model.tensor_model import BrokerState, TensorClusterModel
 from cruise_control_tpu.monitor.load_monitor import (LoadMonitor,
@@ -157,16 +158,29 @@ class CruiseControl:
         return [to_dense[b] for b in broker_ids]
 
     def _base_options(self, model: TensorClusterModel,
-                      naming: Dict[str, object]) -> OptimizationOptions:
-        """Default per-request options with the config-excluded topics
-        applied (topics.excluded.from.partition.movement)."""
+                      naming: Dict[str, object],
+                      excluded_topics_pattern: Optional[str] = None
+                      ) -> OptimizationOptions:
+        """Default per-request options with the excluded topics applied.
+        A per-request ``excluded_topics`` regex OVERRIDES the boot-time
+        topics.excluded.from.partition.movement pattern (the reference's
+        param-else-config resolution, ParameterUtils.java:898)."""
         options = OptimizationOptions.none(model)
-        if self._excluded_topics_pattern is not None:
-            tmask = np.array([bool(self._excluded_topics_pattern.fullmatch(t))
+        pattern = (re.compile(excluded_topics_pattern)
+                   if excluded_topics_pattern
+                   else self._excluded_topics_pattern)
+        if pattern is not None:
+            tmask = np.array([bool(pattern.fullmatch(t))
                               for t in naming["topics"]], bool)
             if tmask.any():
                 options = options.replace(topic_excluded=jnp.asarray(tmask))
         return options
+
+    @staticmethod
+    def _request_strategy(names: Optional[Sequence[str]]):
+        """Resolve a per-request movement-strategy chain (None -> use the
+        executor's boot-time strategy)."""
+        return resolve_strategy(list(names)) if names else None
 
     def _validate_goals(self, goals: Sequence[str]) -> None:
         """User-requested goals must be in goals= (the supported set);
@@ -236,7 +250,8 @@ class CruiseControl:
 
     def _finish(self, model: TensorClusterModel, run: opt.OptimizerRun,
                 dryrun: bool, reason: str, naming: Dict[str, object],
-                verify: bool = True) -> OperationResult:
+                verify: bool = True, strategy=None,
+                replication_throttle: Optional[int] = None) -> OperationResult:
         # Verification runs on dense indices (the model's own numbering);
         # everything leaving the facade — REST payloads and the executor's
         # ReassignmentRequests / throttle entries — carries cluster ids from
@@ -270,7 +285,8 @@ class CruiseControl:
             # handler idle ratio each interval).
             execution = self.executor.execute_proposals(
                 proposals, naming["partitions"],
-                concurrency_adjust_metrics=self.load_monitor.broker_health_metrics)
+                concurrency_adjust_metrics=self.load_monitor.broker_health_metrics,
+                strategy=strategy, replication_throttle=replication_throttle)
             ok = execution.ok
         return OperationResult(
             ok=ok, dryrun=dryrun, proposals=proposals,
@@ -287,11 +303,14 @@ class CruiseControl:
     # Proposals (cached)
     # ------------------------------------------------------------------
     def proposals(self, goals: Optional[Sequence[str]] = None,
-                  ignore_proposal_cache: bool = False) -> OperationResult:
+                  ignore_proposal_cache: bool = False,
+                  excluded_topics_pattern: Optional[str] = None
+                  ) -> OperationResult:
         """GET /proposals — cached while the model generation is unchanged
         and the cache is younger than proposal.expiration.ms."""
         gen = self.load_monitor.model_generation().as_tuple()
-        use_cache = not ignore_proposal_cache and not goals
+        use_cache = (not ignore_proposal_cache and not goals
+                     and not excluded_topics_pattern)
         if use_cache:
             with self._cache_lock:
                 if self._cached is not None:
@@ -313,7 +332,8 @@ class CruiseControl:
         model, naming = self._model_naming()
         if goals:
             self._validate_goals(goals)
-        run = self._optimize(model, goals, naming=naming)
+        options = self._base_options(model, naming, excluded_topics_pattern)
+        run = self._optimize(model, goals, options)
         result = self._finish(model, run, dryrun=True, reason="proposals",
                               naming=naming)
         # Only verified-good runs are cacheable: a cached entry is always
@@ -336,14 +356,18 @@ class CruiseControl:
                   reason: str = "rebalance",
                   fast_mode: bool = False,
                   rebalance_disk: bool = False,
-                  self_healing: bool = False) -> OperationResult:
+                  self_healing: bool = False,
+                  excluded_topics_pattern: Optional[str] = None,
+                  replica_movement_strategies: Optional[Sequence[str]] = None,
+                  replication_throttle: Optional[int] = None) -> OperationResult:
         model, naming = self._model_naming()
         if goals and not self_healing:
             # Self-healing fixes run detection goals, which an operator may
             # configure beyond the request-facing goals= set — internal
             # stacks are not gated (see _validate_goals).
             self._validate_goals(goals)
-        options = self._base_options(model, naming)
+        strategy = self._request_strategy(replica_movement_strategies)
+        options = self._base_options(model, naming, excluded_topics_pattern)
         if destination_broker_ids:
             mask = np.zeros(model.num_brokers, bool)
             mask[self._to_dense(naming, destination_broker_ids)] = True
@@ -359,31 +383,46 @@ class CruiseControl:
             # (intra.broker.goals) instead of the inter-broker default.
             goals = self.intra_broker_goals
         run = self._optimize(model, goals, options, fast_mode=fast_mode)
-        return self._finish(model, run, dryrun, reason, naming)
+        return self._finish(model, run, dryrun, reason, naming,
+                            strategy=strategy,
+                            replication_throttle=replication_throttle)
 
     def add_brokers(self, broker_ids: Sequence[int], dryrun: bool = False,
-                    reason: str = "add_brokers") -> OperationResult:
+                    reason: str = "add_brokers",
+                    excluded_topics_pattern: Optional[str] = None,
+                    replica_movement_strategies: Optional[Sequence[str]] = None,
+                    replication_throttle: Optional[int] = None) -> OperationResult:
         """Move load onto NEW brokers (AddBrokersRunnable)."""
         model, naming = self._model_naming()
         for b in self._to_dense(naming, broker_ids):
             model = model.set_broker_state(b, BrokerState.NEW)
         self.executor.drop_recently_removed_brokers(list(broker_ids))
-        run = self._optimize(model, self.goals, naming=naming)
-        return self._finish(model, run, dryrun, reason, naming)
+        strategy = self._request_strategy(replica_movement_strategies)
+        options = self._base_options(model, naming, excluded_topics_pattern)
+        run = self._optimize(model, self.goals, options)
+        return self._finish(model, run, dryrun, reason, naming,
+                            strategy=strategy,
+                            replication_throttle=replication_throttle)
 
     def remove_brokers(self, broker_ids: Sequence[int], dryrun: bool = False,
                        reason: str = "remove_brokers",
-                       self_healing: bool = False) -> bool:
+                       self_healing: bool = False,
+                       excluded_topics_pattern: Optional[str] = None,
+                       replica_movement_strategies: Optional[Sequence[str]] = None,
+                       replication_throttle: Optional[int] = None) -> bool:
         """Decommission: drain all replicas off the brokers
         (RemoveBrokersRunnable)."""
         model, naming = self._model_naming()
         for b in self._to_dense(naming, broker_ids):
             model = model.set_broker_state(b, BrokerState.DEAD)
-        options = self._base_options(model, naming)
+        strategy = self._request_strategy(replica_movement_strategies)
+        options = self._base_options(model, naming, excluded_topics_pattern)
         if self_healing:
             options = self._self_heal_excludes(options, naming)
         run = self._optimize(model, self.goals, options)
-        result = self._finish(model, run, dryrun, reason, naming)
+        result = self._finish(model, run, dryrun, reason, naming,
+                              strategy=strategy,
+                              replication_throttle=replication_throttle)
         if result.ok and not dryrun:
             self.executor.add_recently_removed_brokers(list(broker_ids))
         return result.ok

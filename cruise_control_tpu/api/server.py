@@ -27,6 +27,9 @@ import base64
 import dataclasses
 import hmac
 import json
+import mimetypes
+import os
+import re
 import threading
 import time
 import urllib.parse
@@ -125,6 +128,46 @@ def _parse_ids(q: Dict[str, str], key: str) -> List[int]:
 def _parse_goals(q: Dict[str, str]) -> Optional[List[str]]:
     raw = q.get("goals", "")
     return [g for g in raw.split(",") if g] or None
+
+
+def _parse_excluded_topics(q: Dict[str, str]) -> Optional[str]:
+    """Per-request excluded-topics regex (ParameterUtils.java:898) —
+    overrides the boot topics.excluded.from.partition.movement pattern."""
+    raw = q.get("excluded_topics")
+    if not raw:
+        return None
+    try:
+        re.compile(raw)
+    except re.error as e:
+        raise BadRequest(f"invalid excluded_topics regex {raw!r}: {e}") from e
+    return raw
+
+
+def _parse_strategies(q: Dict[str, str]) -> Optional[List[str]]:
+    """Per-request movement-strategy chain (ParameterUtils.java:733)."""
+    names = [s for s in q.get("replica_movement_strategies", "").split(",") if s]
+    if not names:
+        return None
+    from cruise_control_tpu.executor.strategy import resolve_strategy
+    try:
+        resolve_strategy(names)
+    except ValueError as e:
+        raise BadRequest(str(e)) from e
+    return names
+
+
+def _parse_throttle(q: Dict[str, str]) -> Optional[int]:
+    """Per-request replication throttle rate (ParameterUtils.java:418)."""
+    raw = q.get("replication_throttle")
+    if raw is None:
+        return None
+    try:
+        rate = int(raw)
+    except ValueError as e:
+        raise BadRequest(f"invalid replication_throttle {raw!r}") from e
+    if rate <= 0:
+        raise BadRequest(f"replication_throttle must be positive, got {rate}")
+    return rate
 
 
 class CruiseControlApi:
@@ -273,11 +316,13 @@ class CruiseControlApi:
     def _ep_proposals(self, q):
         ignore_cache = _parse_bool(q, "ignore_proposal_cache", False)
         goals = _parse_goals(q)
+        excluded = _parse_excluded_topics(q)
 
         def fn(progress):
             progress.add_step("GeneratingClusterModel")
             progress.add_step("OptimizationProposalGeneration")
-            return self.cc.proposals(goals=goals, ignore_proposal_cache=ignore_cache)
+            return self.cc.proposals(goals=goals, ignore_proposal_cache=ignore_cache,
+                                     excluded_topics_pattern=excluded)
         return self._async("proposals", q, fn)
 
     def _ep_user_tasks(self, q):
@@ -332,6 +377,9 @@ class CruiseControlApi:
         dests = _parse_ids(q, "destination_broker_ids")
         fast = _parse_bool(q, "fast_mode", False)
         rebalance_disk = _parse_bool(q, "rebalance_disk", False)
+        excluded = _parse_excluded_topics(q)
+        strategies = _parse_strategies(q)
+        throttle = _parse_throttle(q)
 
         def fn(progress):
             progress.add_step("GeneratingClusterModel")
@@ -339,7 +387,10 @@ class CruiseControlApi:
             return self.cc.rebalance(goals=goals, dryrun=dryrun,
                                      destination_broker_ids=dests or None,
                                      fast_mode=fast,
-                                     rebalance_disk=rebalance_disk)
+                                     rebalance_disk=rebalance_disk,
+                                     excluded_topics_pattern=excluded,
+                                     replica_movement_strategies=strategies,
+                                     replication_throttle=throttle)
         return self._async("rebalance", q, fn)
 
     def _ep_add_broker(self, q):
@@ -347,10 +398,16 @@ class CruiseControlApi:
         if not ids:
             raise BadRequest("brokerid parameter is required")
         dryrun = _parse_bool(q, "dryrun", True)
+        excluded = _parse_excluded_topics(q)
+        strategies = _parse_strategies(q)
+        throttle = _parse_throttle(q)
 
         def fn(progress):
             progress.add_step("OptimizationForGoals")
-            return self.cc.add_brokers(ids, dryrun=dryrun)
+            return self.cc.add_brokers(ids, dryrun=dryrun,
+                                       excluded_topics_pattern=excluded,
+                                       replica_movement_strategies=strategies,
+                                       replication_throttle=throttle)
         return self._async("add_broker", q, fn)
 
     def _ep_remove_broker(self, q):
@@ -358,10 +415,16 @@ class CruiseControlApi:
         if not ids:
             raise BadRequest("brokerid parameter is required")
         dryrun = _parse_bool(q, "dryrun", True)
+        excluded = _parse_excluded_topics(q)
+        strategies = _parse_strategies(q)
+        throttle = _parse_throttle(q)
 
         def fn(progress):
             progress.add_step("OptimizationForGoals")
-            ok = self.cc.remove_brokers(ids, dryrun=dryrun)
+            ok = self.cc.remove_brokers(ids, dryrun=dryrun,
+                                        excluded_topics_pattern=excluded,
+                                        replica_movement_strategies=strategies,
+                                        replication_throttle=throttle)
             return {"ok": ok, "removedBrokers": ids, "dryrun": dryrun}
         return self._async("remove_broker", q, fn)
 
@@ -497,14 +560,52 @@ _INDEX_HTML = """<!doctype html>
 
 class _Handler(BaseHTTPRequestHandler):
     api: CruiseControlApi = None  # injected by serve()
+    ui_dir: Optional[str] = None  # webserver.ui.diskpath static assets
+
+    def _serve_static(self, path: str) -> bool:
+        """Serve a file from ``ui_dir`` (the reference mounts the
+        cruise-control-ui webapp dist dir this way,
+        KafkaCruiseControlApp.java:100-143).  Returns False when the path
+        resolves outside the dir or to no file — callers fall through to
+        the built-in status page / 404."""
+        rel = urllib.parse.unquote(path).lstrip("/") or "index.html"
+        base = os.path.realpath(self.ui_dir)
+        full = os.path.realpath(os.path.join(base, rel))
+        if full != base and not full.startswith(base + os.sep):
+            return False
+        if os.path.isdir(full):
+            full = os.path.join(full, "index.html")
+        if not os.path.isfile(full):
+            return False
+        with open(full, "rb") as f:
+            payload = f.read()
+        ctype = mimetypes.guess_type(full)[0] or "application/octet-stream"
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+        return True
 
     def _dispatch(self, method: str) -> None:
         parsed = urllib.parse.urlparse(self.path)
+        under_api = parsed.path.startswith(PREFIX + "/")
+        if method == "GET" and not under_api and self.ui_dir:
+            # The UI sits behind the same security provider as the API
+            # (the reference's Jetty security handler covers the mounted
+            # webapp context too).
+            if self.api.security.authenticate(dict(self.headers)) is None:
+                challenge = getattr(self.api.security, "challenge_headers", None)
+                self._reply(401, {"error": "authentication required"},
+                            challenge() if callable(challenge) else {})
+                return
+            if self._serve_static(parsed.path):
+                return
         if method == "GET" and parsed.path.rstrip("/") in ("", PREFIX):
             self._reply(200, HtmlText(_INDEX_HTML.replace("%PREFIX%", PREFIX)),
                         {})
             return
-        if not parsed.path.startswith(PREFIX + "/"):
+        if not under_api:
             self._reply(404, {"error": f"paths live under {PREFIX}/"}, {})
             return
         endpoint = parsed.path[len(PREFIX) + 1:].strip("/")
@@ -544,11 +645,12 @@ class _Handler(BaseHTTPRequestHandler):
               f"{fmt % args}", file=sys.stderr)
 
 
-def serve(api: CruiseControlApi, host: str = "127.0.0.1", port: int = 9090
-          ) -> ThreadingHTTPServer:
+def serve(api: CruiseControlApi, host: str = "127.0.0.1", port: int = 9090,
+          ui_dir: Optional[str] = None) -> ThreadingHTTPServer:
     """Start the HTTP server on a daemon thread; returns the server object
-    (KafkaCruiseControlApp.start analogue)."""
-    handler = type("BoundHandler", (_Handler,), {"api": api})
+    (KafkaCruiseControlApp.start analogue).  ``ui_dir`` serves static
+    web-UI assets at / (webserver.ui.diskpath)."""
+    handler = type("BoundHandler", (_Handler,), {"api": api, "ui_dir": ui_dir})
     server = ThreadingHTTPServer((host, port), handler)
     threading.Thread(target=server.serve_forever, daemon=True,
                      name="cc-http-server").start()

@@ -386,7 +386,8 @@ class KafkaCruiseControlApp:
         port = self._port_override
         if port is None:
             port = cfg.get(C.WEBSERVER_HTTP_PORT_CONFIG)
-        self._server = serve(self.api, host=host, port=port)
+        self._server = serve(self.api, host=host, port=port,
+                             ui_dir=cfg.get(C.WEBSERVER_UI_DISKPATH_CONFIG) or None)
         self.port = self._server.server_address[1]
         return self.port
 

@@ -423,6 +423,7 @@ WEBSERVER_SECURITY_PROVIDER_CONFIG = "webserver.security.provider"
 SPNEGO_KEYTAB_FILE_CONFIG = "spnego.keytab.file"
 SPNEGO_PRINCIPAL_CONFIG = "spnego.principal"
 WEBSERVER_AUTH_CREDENTIALS_FILE_CONFIG = "webserver.auth.credentials.file"
+WEBSERVER_UI_DISKPATH_CONFIG = "webserver.ui.diskpath"
 TWO_STEP_VERIFICATION_ENABLED_CONFIG = "two.step.verification.enabled"
 TWO_STEP_PURGATORY_RETENTION_TIME_MS_CONFIG = "two.step.purgatory.retention.time.ms"
 TWO_STEP_PURGATORY_MAX_REQUESTS_CONFIG = "two.step.purgatory.max.requests"
@@ -447,6 +448,11 @@ def webserver_config_def() -> ConfigDef:
              importance=Importance.MEDIUM, doc="Security provider plugin.", group="webserver")
     d.define(WEBSERVER_AUTH_CREDENTIALS_FILE_CONFIG, Type.STRING, "", importance=Importance.MEDIUM,
              doc="Credentials file for basic auth.", group="webserver")
+    d.define(WEBSERVER_UI_DISKPATH_CONFIG, Type.STRING, "", importance=Importance.LOW,
+             doc="Directory of static web-UI assets served at / (the "
+                 "cruise-control-ui dist dir in the reference, "
+                 "WebServerConfig.java:79); empty serves the built-in "
+                 "status page.", group="webserver")
     d.define(SPNEGO_KEYTAB_FILE_CONFIG, Type.STRING, "", importance=Importance.LOW,
              doc="Service keytab for the SPNEGO security provider.", group="webserver")
     d.define(SPNEGO_PRINCIPAL_CONFIG, Type.STRING, "", importance=Importance.LOW,

@@ -293,7 +293,9 @@ class Executor:
                           max_polls: int = 10_000,
                           poll_interval_s: Optional[float] = None,
                           concurrency_adjust_metrics: Optional[
-                              Callable[[], Dict[int, Dict[str, float]]]] = None
+                              Callable[[], Dict[int, Dict[str, float]]]] = None,
+                          strategy: Optional[ReplicaMovementStrategy] = None,
+                          replication_throttle: Optional[int] = None
                           ) -> ExecutionResult:
         """Run the full three-phase execution to completion.
 
@@ -301,6 +303,10 @@ class Executor:
         to its (topic, partition) — the naming seam between the tensor world
         and the cluster protocol.  ``poll_interval_s=None`` uses the
         configured execution.progress.check.interval.ms cadence.
+        ``strategy`` / ``replication_throttle`` override the boot-time
+        movement strategy and throttle rate for THIS execution only (the
+        reference accepts both per request,
+        ParameterUtils.java:418 + :733; KafkaCruiseControl.java:465-495).
         """
         if poll_interval_s is None:
             poll_interval_s = self._progress_check_interval_s
@@ -320,7 +326,10 @@ class Executor:
         if self._on_pause:
             self._on_pause("ongoing execution")
         try:
-            planner = ExecutionTaskPlanner(self._strategy)
+            planner = ExecutionTaskPlanner(
+                strategy if strategy is not None else self._strategy)
+            throttle = (ReplicationThrottleHelper(self._admin, replication_throttle)
+                        if replication_throttle is not None else self._throttle)
             plan = planner.plan(proposals, context)
             tm = ExecutionTaskManager(plan, self._limits)
             with self._lock:
@@ -334,14 +343,14 @@ class Executor:
                     self._state = ExecutorState.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
                 involved = sorted({b for t in plan.inter_broker_tasks
                                    for b in t.brokers_involved()})
-                self._throttle.set_throttles(plan.inter_broker_tasks, partition_names)
+                throttle.set_throttles(plan.inter_broker_tasks, partition_names)
                 try:
                     polls, stopped = self._run_inter_broker_phase(
                         tm, partition_names, max_polls, poll_interval_s,
                         concurrency_adjust_metrics)
                 finally:
-                    self._throttle.clear_throttles(plan.inter_broker_tasks,
-                                                   partition_names)
+                    throttle.clear_throttles(plan.inter_broker_tasks,
+                                             partition_names)
 
             # Phase 2: intra-broker (logdir) movement.
             if plan.intra_broker_tasks and not stopped and not self._stop_requested:

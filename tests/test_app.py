@@ -193,3 +193,37 @@ def test_rest_rebalance_executes_over_wire(tmp_path):
         client.close()
     finally:
         fb.stop()
+
+
+def test_app_serves_static_ui_assets(tmp_path):
+    """webserver.ui.diskpath serves a static web-UI directory at / (the
+    reference mounts cruise-control-ui/dist the same way,
+    KafkaCruiseControlApp.java:100-143), while the API prefix keeps working."""
+    import urllib.error
+    ui = tmp_path / "ui"
+    ui.mkdir()
+    (ui / "index.html").write_text("<html>tpu-ui</html>")
+    (ui / "app.js").write_text("console.log('ui')")
+    props = tmp_path / "cc.properties"
+    props.write_text("metric.sampling.interval.ms=100000\n"
+                     "webserver.http.port=0\n"
+                     f"webserver.ui.diskpath={ui}\n")
+    config = cruise_control_config(load_properties(str(props)))
+    app = KafkaCruiseControlApp(config)
+    port = app.start()
+    try:
+        base = f"http://127.0.0.1:{port}"
+        r = urllib.request.urlopen(f"{base}/")
+        assert b"tpu-ui" in r.read()
+        assert r.headers["Content-Type"].startswith("text/html")
+        r = urllib.request.urlopen(f"{base}/app.js")
+        assert b"console.log" in r.read()
+        # Path traversal out of the UI dir is refused.
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/..%2Fcc.properties")
+        # The API still answers under its prefix.
+        state = json.load(urllib.request.urlopen(
+            f"{base}/kafkacruisecontrol/state"))
+        assert "MonitorState" in state
+    finally:
+        app.stop()

@@ -1,0 +1,147 @@
+"""Per-request override wiring: excluded_topics / replica_movement_strategies
+/ replication_throttle reach the facade and executor per operation,
+overriding boot-time config (the reference resolves each as
+param-else-config: ParameterUtils.java:418, :733, :898;
+KafkaCruiseControl.java:465-495).
+"""
+
+import numpy as np
+
+from cruise_control_tpu.api.facade import CruiseControl
+from cruise_control_tpu.executor.admin import InMemoryClusterAdmin
+from cruise_control_tpu.executor.executor import Executor
+from cruise_control_tpu.executor.strategy import ReplicaMovementStrategy
+from cruise_control_tpu.monitor.capacity import StaticCapacityResolver
+from cruise_control_tpu.monitor.load_monitor import LoadMonitor
+from cruise_control_tpu.monitor.metadata import (BrokerInfo, ClusterMetadata,
+                                                 MetadataClient, PartitionInfo)
+from cruise_control_tpu.monitor.sampling import SyntheticWorkloadSampler
+
+from tests.test_api import build_stack
+
+W = 300_000
+
+GOALS = ["RackAwareGoal", "ReplicaDistributionGoal"]
+
+
+def build_cc(excluded_topics_pattern=None, num_brokers=5):
+    rng = np.random.default_rng(7)
+    brokers = tuple(BrokerInfo(b, rack=f"r{b % 3}", host=f"h{b}")
+                    for b in range(num_brokers))
+    w = np.linspace(1, 4, num_brokers)
+    w /= w.sum()
+    parts = []
+    for t in range(3):
+        for p in range(8):
+            reps = tuple(int(x) for x in
+                         rng.choice(num_brokers, 2, replace=False, p=w))
+            parts.append(PartitionInfo(f"t{t}", p, leader=reps[0], replicas=reps))
+    mc = MetadataClient(ClusterMetadata(brokers=brokers, partitions=tuple(parts)))
+    lm = LoadMonitor(mc, StaticCapacityResolver(), num_partition_windows=3,
+                     partition_window_ms=W)
+    lm.start_up()
+    sampler = SyntheticWorkloadSampler()
+    for wdx in range(4):
+        lm.fetch_once(sampler, wdx * W, wdx * W + 1)
+    admin = InMemoryClusterAdmin(mc, latency_polls=1)
+    ex = Executor(admin, mc)
+    cc = CruiseControl(lm, ex, admin, goals=GOALS, hard_goals=["RackAwareGoal"],
+                       excluded_topics_pattern=excluded_topics_pattern)
+    return cc, lm, ex, admin
+
+
+def proposal_topics(cc, lm, result):
+    naming = lm.naming()
+    parts = naming["partitions"]
+    return {parts[p.partition][0] for p in result.proposals}
+
+
+def test_request_excluded_topics_excludes_matching():
+    cc, lm, _, _ = build_cc()
+    base = cc.rebalance(dryrun=True)
+    assert "t0" in proposal_topics(cc, lm, base)  # t0 moves without the filter
+    res = cc.rebalance(dryrun=True, excluded_topics_pattern="t0")
+    topics = proposal_topics(cc, lm, res)
+    assert "t0" not in topics and topics  # others still move
+
+
+def test_request_excluded_topics_overrides_boot_config():
+    cc, lm, _, _ = build_cc(excluded_topics_pattern="t0")
+    boot = cc.rebalance(dryrun=True)
+    assert "t0" not in proposal_topics(cc, lm, boot)
+    # The request pattern REPLACES the boot pattern (param-else-config):
+    # t0 becomes movable again, t1 is now excluded.
+    res = cc.rebalance(dryrun=True, excluded_topics_pattern="t1")
+    topics = proposal_topics(cc, lm, res)
+    assert "t1" not in topics and "t0" in topics
+
+
+def test_request_excluded_topics_on_proposals_endpoint():
+    cc, lm, _, _ = build_cc()
+    res = cc.proposals(excluded_topics_pattern="t.*")
+    assert not res.proposals  # everything excluded -> nothing to move
+    # ...and the all-excluded run must not have poisoned the cache.
+    res2 = cc.proposals()
+    assert res2.reason != "cached" and res2.proposals
+
+
+def test_request_strategy_and_throttle_reach_executor():
+    cc, lm, ex, admin = build_cc()
+    captured = {}
+    orig = ex.execute_proposals
+
+    def spy(*args, **kwargs):
+        captured.update(kwargs)
+        return orig(*args, **kwargs)
+
+    ex.execute_proposals = spy
+    res = cc.rebalance(dryrun=False,
+                       replica_movement_strategies=["prioritize-large"],
+                       replication_throttle=12_345)
+    assert res.ok and res.proposals
+    assert captured["strategy"].name == "prioritize-large"
+    assert captured["replication_throttle"] == 12_345
+    # The boot executor has NO throttle; the per-request rate must be the
+    # one that hit the cluster.
+    assert admin.throttle_history
+    assert all(h["rate"] == 12_345 for h in admin.throttle_history)
+    assert not admin.throttle_state  # cleaned up after the batch
+
+
+def test_executor_strategy_override_orders_tasks():
+    calls = []
+
+    class RecordingStrategy(ReplicaMovementStrategy):
+        name = "recording"
+
+        def sort_key(self, task, context):
+            calls.append(task.execution_id)
+            return (task.execution_id,)
+
+    cc, lm, ex, admin = build_cc()
+    res = cc.rebalance(dryrun=True)
+    naming = lm.naming()
+    ex.execute_proposals(res.proposals, naming["partitions"],
+                         strategy=RecordingStrategy())
+    assert calls  # the override strategy ordered the batch
+
+
+def test_api_rejects_bad_override_params():
+    api, _, _ = build_stack()
+    s, body, _ = api.handle("POST", "rebalance",
+                            {"replica_movement_strategies": "nope"})
+    assert s == 400 and "nope" in body["error"]
+    s, body, _ = api.handle("POST", "rebalance", {"excluded_topics": "("})
+    assert s == 400 and "excluded_topics" in body["error"]
+    s, body, _ = api.handle("POST", "rebalance", {"replication_throttle": "x"})
+    assert s == 400 and "replication_throttle" in body["error"]
+
+
+def test_api_accepts_override_params():
+    api, _, _ = build_stack()
+    s, body, _ = api.handle("POST", "rebalance", {
+        "max_wait_s": "300",
+        "excluded_topics": "t0",
+        "replica_movement_strategies": "prioritize-large,postpone-urp",
+        "replication_throttle": "1000000"})
+    assert s == 200

@@ -137,6 +137,23 @@ def main():
         before0 = None
         chunks = []
         capped = True
+        aft = 0
+        cur = progress.get("current")
+        if cur and cur["name"] == name:
+            # Resume mid-goal: the model checkpoint already holds the work
+            # of the recorded chunks; restore their counters AND the last
+            # chunk's convergence flags (a crash between the final chunk's
+            # save and the goal-entry save must not re-run a converged goal
+            # or leave `aft` unbound when n_chunks == max_chunks).
+            chunks = list(cur["chunks"])
+            steps = sum(c["steps"] for c in chunks)
+            actions = sum(c["actions"] for c in chunks)
+            n_chunks = len(chunks)
+            before0 = cur.get("satisfied_before")
+            capped = bool(cur.get("capped", True))
+            aft = int(cur.get("satisfied_after", 0))
+            print(f"{name}: resuming mid-goal at chunk {n_chunks + 1}",
+                  flush=True)
         while capped and n_chunks < max_chunks:
             t0 = time.monotonic()
             out = fix(model, options)
@@ -151,6 +168,10 @@ def main():
             n_chunks += 1
             capped = bool(cap)
             chunks.append({"steps": s, "actions": a, "wall_s": round(wall, 1)})
+            progress["current"] = {"name": name, "chunks": chunks,
+                                   "satisfied_before": before0,
+                                   "satisfied_after": int(aft),
+                                   "capped": capped}
             elapsed = base_elapsed + (time.monotonic() - t_round)
             print(f"{name} chunk {n_chunks}: steps={s} actions={a} "
                   f"capped={capped} satisfied={bool(aft)} "
@@ -164,6 +185,7 @@ def main():
             "wall_s": round(sum(c["wall_s"] for c in chunks), 1),
         }
         progress["completed"].append(entry)
+        progress.pop("current", None)
         prev = prev + (gspec,)
         save_state(base_elapsed + (time.monotonic() - t_round))
         print(f"{name} DONE: steps={steps} actions={actions} "
@@ -194,6 +216,9 @@ def main():
         "num_replicas": num_replicas,
         "num_brokers": nb,
         "devices": n,
+        "num_sources": ns,
+        "num_dests": nd,
+        "chunk_steps": chunk,
         "backend": devs[0].platform,
         "optimize_wall_s": round(progress["elapsed_s"], 1),
         "proposal_diff_s": round(diff_s, 1),
