@@ -57,6 +57,15 @@ def default_num_dests(model: TensorClusterModel) -> int:
     return max(1, min(b, max(32, min(b // 8, 1024))))
 
 
+def _recv_ok(arrays: BrokerArrays, options: OptimizationOptions) -> Array:
+    """bool[B] — brokers able to receive replicas for this request (alive,
+    not move-excluded, inside the requested destination set when one is
+    given)."""
+    ok = arrays.alive & ~options.broker_excluded_replica_move
+    any_requested = options.requested_dest_only.any()
+    return ok & (~any_requested | options.requested_dest_only)
+
+
 def move_candidates(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
                     constraint: BalancingConstraint, options: OptimizationOptions,
                     num_sources: int, num_dests: int) -> Candidates:
@@ -65,10 +74,7 @@ def move_candidates(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArr
     rel_vals, src_replicas = jax.lax.top_k(relevance, num_sources)  # [S]
     room = kernels.dest_room(spec, model, arrays, constraint)
     # Destinations must be able to receive replicas at all.
-    recv_ok = arrays.alive & ~options.broker_excluded_replica_move
-    any_requested = options.requested_dest_only.any()
-    recv_ok = recv_ok & (~any_requested | options.requested_dest_only)
-    room = jnp.where(recv_ok, room, -jnp.inf)
+    room = jnp.where(_recv_ok(arrays, options), room, -jnp.inf)
     _, dest_brokers = jax.lax.top_k(room, num_dests)  # [D]
 
     replica = jnp.repeat(src_replicas, num_dests)          # [K]
@@ -81,6 +87,101 @@ def move_candidates(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArr
 
     valid = src_ok & _legit_move_mask(model, arrays, options, replica, dest)
     return make_candidates(model, replica, dest, action_type, dest_replica, valid)
+
+
+def matched_move_candidates(spec: GoalSpec, model: TensorClusterModel,
+                            arrays: BrokerArrays, constraint: BalancingConstraint,
+                            options: OptimizationOptions, num_out: int) -> Candidates:
+    """K = num_out 1:1 MATCHED move candidates for the replica-count
+    distribution goal: the surplus replicas of over-band brokers are
+    assigned to under-band brokers' remaining room by a prefix-sum
+    transport match, one candidate per replica.
+
+    The S×D cross batch structurally throttles a hot broker: its many
+    sources hash into shared (broker, lane) segments and duplicate replicas
+    across lanes are deduped by the partition pass, so a broker sheds well
+    under the lane width per step (the round-4 mid rung spent 26 of 78
+    steps in this goal at ~120 accepts/step against a 3,120-replica
+    surplus).  Here every candidate is a distinct replica with exactly one
+    destination, chosen so no destination is offered more than its room —
+    the conflict-free selection then keeps essentially the whole batch and
+    the fixpoint collapses to a handful of steps.  The reference's
+    per-broker rebalance loop reaches the same fixpoint one replica at a
+    time (ReplicaDistributionGoal's rebalanceForBroker sweep,
+    goals/ReplicaDistributionGoal.java); the matching is the batched
+    equivalent, with the band budgets in select_batched still enforcing
+    exactness.
+    """
+    B = model.num_brokers
+    R = model.num_replicas_padded
+    metric = kernels.broker_metric(spec, model, arrays, constraint)  # f32[B]
+    lower, upper = kernels.limits(spec, model, arrays, constraint)
+    # Shed target: down to the upper band normally; down to the band
+    # midpoint while some broker sits below the lower band (the pull phase,
+    # rebalanceByMovingLoadIn, ResourceDistributionGoal.java:446-535 —
+    # in-band brokers above the midpoint donate too).  One threshold covers
+    # both phases without double-counting an over-band broker's surplus.
+    under_exists = (arrays.alive & (metric < lower)).any()
+    shed_to = jnp.where(under_exists, (lower + upper) * 0.5, upper)
+    src_n = jnp.ceil(jnp.maximum(metric - shed_to, 0.0)).astype(jnp.int32)
+    recv_ok = _recv_ok(arrays, options)
+    room_n = jnp.where(recv_ok,
+                       jnp.floor(jnp.maximum(upper - metric, 0.0)), 0.0
+                       ).astype(jnp.int32)
+
+    # Rank each replica within its broker (stable sort by broker; invalid
+    # replicas sort last) so exactly the first over_n[b] replicas of broker
+    # b become sources.
+    rb = model.replica_broker
+    key = jnp.where(model.replica_valid, rb, B)
+    order = jnp.argsort(key, stable=True)
+    sorted_key = key[order]
+    start = jnp.searchsorted(sorted_key, jnp.arange(B + 1, dtype=sorted_key.dtype),
+                             side="left")
+    rank_sorted = jnp.arange(R, dtype=jnp.int32) - \
+        start[jnp.minimum(sorted_key, B)].astype(jnp.int32)
+    rank = jnp.zeros((R,), jnp.int32).at[order].set(rank_sorted)
+    is_src = model.replica_valid & (rank < src_n[rb])
+
+    # Prioritize sources by the goal's own relevance ranking, then take the
+    # top num_out (static shape).
+    relevance = kernels.source_replica_relevance(spec, model, arrays, constraint)
+    rel = jnp.where(is_src, relevance, -jnp.inf)
+    rel_vals, src_replicas = jax.lax.top_k(rel, num_out)           # [K]
+    src_ok = jnp.isfinite(rel_vals)
+
+    # Transport match: slot i lands on the broker covering position i of the
+    # room prefix sum (biggest receivers first, so heavy room drains first).
+    room_vals, room_order = jax.lax.top_k(room_n, B)               # desc [B]
+    cum = jnp.cumsum(room_vals)
+    slot = jnp.arange(num_out, dtype=cum.dtype)
+    pos = jnp.searchsorted(cum, slot, side="right")
+    dest1 = room_order[jnp.minimum(pos, B - 1)]                    # [K]
+    dest_ok = slot < cum[B - 1]
+    # Second leg: the next broker in room order.  A source whose matched
+    # destination already hosts a sibling would otherwise retry the same
+    # collision next step (the match is deterministic in the model state) —
+    # the selection's partition pass keeps at most one leg per replica, so
+    # this costs no throughput.
+    dest2 = room_order[jnp.minimum(pos + 1, B - 1)]
+
+    replica = jnp.concatenate([src_replicas, src_replicas])
+    dest = jnp.concatenate([dest1, dest2])
+    src_ok2 = jnp.concatenate([src_ok & dest_ok,
+                               src_ok & dest_ok & (dest2 != dest1)])
+    k = replica.shape[0]
+    action_type = jnp.full((k,), ActionType.INTER_BROKER_REPLICA_MOVEMENT,
+                           jnp.int32)
+    dest_replica = jnp.full((k,), -1, jnp.int32)
+    valid = src_ok2 & _legit_move_mask(model, arrays, options, replica, dest)
+    return make_candidates(model, replica, dest, action_type,
+                           dest_replica, valid)
+
+
+def default_num_matched(model: TensorClusterModel, num_sources: int) -> int:
+    """Width of the matched batch: wide enough to cover a whole rung's
+    surplus in a step or two, bounded by the replica axis."""
+    return max(1, min(model.num_replicas_padded, max(16 * num_sources, 4096)))
 
 
 def _legit_move_mask(model: TensorClusterModel, arrays: BrokerArrays,

@@ -78,6 +78,42 @@ class OptimizationFailureException(Exception):
 # Conflict-free selection
 # ---------------------------------------------------------------------------
 
+def _prefix_admit_role(score: Array, seg: Array, deltas: Array, kept: Array,
+                       cum_before: Array, lo: Array, hi: Array,
+                       num_segments: int) -> Array:
+    """bool[K] — per segment (a broker in one role), admit the score-DESC
+    prefix of ``kept`` whose cumulative channel deltas stay inside
+    [lo, hi] given ``cum_before`` already committed.  This is the repair
+    granularity between "keep everything" and the old single-best
+    fallback: a broker near its band edge keeps every action that still
+    fits instead of exactly one (the 1-action/step convergence tails).
+    Rejected candidates' deltas still occupy the prefix sums, so admission
+    is conservative — the caller's exactness while_loop stays the final
+    guarantee."""
+    K = score.shape[0]
+    # Group by segment with score descending inside: stable two-pass sort.
+    o1 = jnp.argsort(-score, stable=True)
+    o2 = jnp.argsort(seg[o1], stable=True)
+    order = o1[o2]
+    s_seg = seg[order]
+    s_deltas = jnp.where(kept[order][:, None], deltas[order], 0.0)
+    cs = jnp.cumsum(s_deltas, axis=0)                       # [K, C]
+    seg_start = jnp.searchsorted(s_seg, jnp.arange(num_segments,
+                                                   dtype=s_seg.dtype))
+    base = jnp.where((seg_start > 0)[:, None],
+                     cs[jnp.maximum(seg_start - 1, 0)], 0.0)  # [B, C]
+    prefix = cum_before[s_seg] + cs - base[s_seg]           # incl. self
+    eps = 1e-6
+    ok = ((prefix <= hi[s_seg] + eps) & (prefix >= lo[s_seg] - eps)).all(axis=1)
+    # A candidate is admitted only if itself and every better-scored
+    # candidate of its segment fit (monotone prefix).
+    bad = jnp.cumsum((~ok).astype(jnp.int32))
+    bad_base = jnp.where(seg_start > 0, bad[jnp.maximum(seg_start - 1, 0)], 0)
+    admit_sorted = ok & ((bad - bad_base[s_seg]) == 0)
+    admit = jnp.zeros((K,), bool).at[order].set(admit_sorted)
+    return kept & admit
+
+
 def _best_per_segment(score: Array, seg: Array, num_segments: int, eligible: Array) -> Array:
     """bool[K] — keep each segment's single highest-scored eligible candidate
     (ties broken by lowest candidate index)."""
@@ -418,12 +454,22 @@ def select_batched(score: Array, cand: Candidates, eligible: Array,
                                         n_tb * nl, contrib)
                 keep = keep & (~contrib | sel)
 
+            hi_tb = jnp.stack([gain_rep, jnp.full_like(gain_rep, jnp.inf)], 1)
+            lo_tb = jnp.stack([-shed_rep, -shed_lead], 1)
+
             def _tb_repair(k):
+                # Score-ranked prefix per violating key (same granularity
+                # fix as the broker-channel repair: single-best fallbacks
+                # made hot (topic, broker) pairs drain 1 action/step).
                 vt = tb_viol(k)
+                cum_tb = jnp.stack([cum_rep, cum_lead], 1)
                 for i in range(num_legs):
                     contrib = leg_contrib(i, k)
-                    top1 = _best_per_segment(score, leg_keys[i], n_tb, contrib)
-                    k = k & (~(contrib & vt[leg_keys[i]]) | top1)
+                    admit = _prefix_admit_role(
+                        score, leg_keys[i],
+                        jnp.stack([d_rep[i], d_lead[i]], 1),
+                        contrib, cum_tb, lo_tb, hi_tb, n_tb)
+                    k = k & (~(contrib & vt[leg_keys[i]]) | admit)
                 return k
 
             # The repair passes run only when some key actually overshot —
@@ -445,24 +491,29 @@ def select_batched(score: Array, cand: Candidates, eligible: Array,
                 out = out | bad_b
             return out
 
-        # Exactness stages: a net-violating broker first falls back to its
-        # single best dest-role action, then its single best src-role action
-        # (preserves throughput for near-budget brokers); any broker STILL
-        # violating — including brokers flipped into violation by another
-        # broker's drops (removing one leg of a compensating pair raises the
-        # partner's net) — sheds ALL its actions until no violation remains.
-        # The loop is monotone (a violating broker always has a kept action
-        # to drop, since cum_net alone respects the bounds by induction), so
-        # it terminates and the post-step state respects every band exactly.
-        # The whole block is conditional: steps whose lane winners fit their
-        # budgets (the common case) skip every repair pass.
+        # Exactness stages: a net-violating broker keeps the score-ranked
+        # PREFIX of its actions that still fits the remaining budgets (per
+        # role; the old single-best fallback produced 1-action/step
+        # convergence tails at band edges — 16 such steps in the mid rung's
+        # ReplicaDistribution fixpoint); any broker STILL violating —
+        # including brokers flipped into violation by another broker's
+        # drops (removing one leg of a compensating pair raises the
+        # partner's net) — sheds ALL its actions until no violation
+        # remains.  The loop is monotone (a violating broker always has a
+        # kept action to drop, since cum_net alone respects the bounds by
+        # induction), so it terminates and the post-step state respects
+        # every band exactly.  The whole block is conditional: steps whose
+        # lane winners fit their budgets (the common case) skip every
+        # repair pass.
         def _broker_repair(k):
             v = net_viol(k)
-            top1_dest = _best_per_segment(score, cand.dest, num_brokers, k)
-            k = k & (~v[cand.dest] | top1_dest)
+            admit_d = _prefix_admit_role(score, cand.dest, d_dest, k, cum_net,
+                                         -slack_src, room_dest, num_brokers)
+            k = k & (~v[cand.dest] | admit_d)
             v = net_viol(k)
-            top1_src = _best_per_segment(score, cand.src, num_brokers, k)
-            k = k & (~v[cand.src] | top1_src)
+            admit_s = _prefix_admit_role(score, cand.src, d_src, k, cum_net,
+                                         -slack_src, room_dest, num_brokers)
+            k = k & (~v[cand.src] | admit_s)
 
             def _drop_violators(kk):
                 vv = net_viol(kk)
@@ -530,6 +581,19 @@ def _topic_budgets(all_specs: Tuple[GoalSpec, ...], model: TensorClusterModel,
     return gain_rep, shed_rep, shed_lead
 
 
+def _goal_num_sources(spec: GoalSpec, model: TensorClusterModel,
+                      num_sources: int) -> int:
+    """Per-goal source-width policy.  Rack healing is purely source-bound
+    (every conflicted replica is one independent fix; the mid rung spent 5
+    steps draining 699 conflicts 140-at-a-time through ns=200), so it gets
+    a wide batch; band goals keep the configured width — their throughput
+    is budget- and lane-bound, and wider cross batches measurably hurt
+    (round-5 sweep: ns=512 at mid grew the stack 78 -> 95 steps)."""
+    if spec.kind in ("rack", "rack_distribution"):
+        return max(1, min(model.num_replicas_padded, max(4 * num_sources, 1024)))
+    return num_sources
+
+
 def _goal_step(model: TensorClusterModel, options: OptimizationOptions,
                spec: GoalSpec, prev_specs: Tuple[GoalSpec, ...],
                constraint: BalancingConstraint,
@@ -543,11 +607,20 @@ def _goal_step(model: TensorClusterModel, options: OptimizationOptions,
     parallel/mesh.py).
     """
     arrays = BrokerArrays.from_model(model)
+    num_sources = _goal_num_sources(spec, model, num_sources)
 
     batches = []
     if spec.uses_moves:
         batches.append(cgen.move_candidates(spec, model, arrays, constraint, options,
                                             num_sources, num_dests))
+        if spec.kind == "replica_distribution":
+            # The 1:1 transport-matched batch drains count surpluses at
+            # batch width (see matched_move_candidates); the cross batch
+            # stays as the explorer for pairs the match rejects (sibling /
+            # rack collisions).
+            batches.append(cgen.matched_move_candidates(
+                spec, model, arrays, constraint, options,
+                cgen.default_num_matched(model, num_sources)))
     if spec.uses_leadership:
         batches.append(cgen.leadership_candidates(spec, model, arrays, constraint,
                                                   options, num_sources))
@@ -956,6 +1029,13 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
                 model, packed = stack_fn(model, options)
                 packed_rows.append(packed)
             prev = prev + chunk
+        # Overlap the control-plane fetch with the result arrays the caller
+        # will read next (props.diff): async host copies ride the same sync
+        # the packed fetch pays, so the diff's device_get is then free.
+        for arr in (model.replica_broker, model.replica_disk,
+                    model.replica_is_leader):
+            if hasattr(arr, "copy_to_host_async"):
+                arr.copy_to_host_async()
         fetched = jax.device_get(tuple(packed_rows))
         steps_v, actions_v, before_v, after_v, capped_v = (
             np.concatenate([row[i] for row in fetched]) for i in range(5))

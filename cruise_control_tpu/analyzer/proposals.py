@@ -14,8 +14,7 @@ fast path takes over at the 1M-replica scale (see native/).
 
 from __future__ import annotations
 
-import dataclasses
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import numpy as np
@@ -23,16 +22,17 @@ import numpy as np
 from cruise_control_tpu.model.tensor_model import TensorClusterModel
 
 
-@dataclasses.dataclass(frozen=True)
-class ReplicaPlacement:
-    """(broker, disk) placement (model/ReplicaPlacementInfo.java)."""
+class ReplicaPlacement(NamedTuple):
+    """(broker, disk) placement (model/ReplicaPlacementInfo.java).
+    A NamedTuple, not a frozen dataclass: a 100k-replica diff builds ~100k
+    of these and frozen-dataclass __init__ (object.__setattr__ per field)
+    was ~10x the construction cost."""
 
     broker: int
     disk: int = -1
 
 
-@dataclasses.dataclass(frozen=True)
-class ExecutionProposal:
+class ExecutionProposal(NamedTuple):
     """One partition's reassignment (executor/ExecutionProposal.java:26)."""
 
     partition: int
@@ -99,8 +99,8 @@ def renumber_brokers(proposals: List[ExecutionProposal],
     def pl(p: ReplicaPlacement) -> ReplicaPlacement:
         return ReplicaPlacement(int(broker_ids[p.broker]), p.disk)
 
-    return [dataclasses.replace(
-        p, old_leader=pl(p.old_leader),
+    return [p._replace(
+        old_leader=pl(p.old_leader),
         old_replicas=tuple(pl(x) for x in p.old_replicas),
         new_replicas=tuple(pl(x) for x in p.new_replicas)) for p in proposals]
 
