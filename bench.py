@@ -41,6 +41,12 @@ SCALES = {
     "small": (3, 3, 5, 20.0, 3),        # ~300-replica ladder rung
     "mid": (50, 10, 40, 84.0, 3),       # ~50-broker / 10k-replica rung
     "large": (200, 20, 100, 333.0, 3),  # ~200-broker / 100k-replica rung
+    # Compile-ceiling probe rungs between large and xl (the tunneled chip's
+    # remote-compile service hangs on 1M-replica shapes; these binary-search
+    # the largest shape that compiles — round-4 verdict weak #3).
+    "xl250": (1000, 40, 200, 417.0, 3),   # ~250k replicas
+    "xl500": (1000, 40, 200, 833.0, 3),   # ~500k replicas
+    "xl750": (1000, 40, 200, 1250.0, 3),  # ~750k replicas
     "xl": (1000, 40, 200, 1667.0, 3),   # stretch rung toward 7k/1M
 }
 

@@ -178,10 +178,117 @@ def matched_move_candidates(spec: GoalSpec, model: TensorClusterModel,
                            dest_replica, valid)
 
 
+def matched_topic_candidates(spec: GoalSpec, model: TensorClusterModel,
+                             arrays: BrokerArrays, constraint: BalancingConstraint,
+                             options: OptimizationOptions, num_out: int) -> Candidates:
+    """K = 2·num_out matched move candidates for TopicReplicaDistribution:
+    the per-(topic, broker) overages are matched onto the same topic's
+    under-band pairs by a per-topic prefix-sum transport (the topic-major
+    flattening keeps every topic's slots contiguous, so one global cumsum +
+    searchsorted assigns all topics at once).  Same rationale as
+    matched_move_candidates — the goal's S×D cross batch drains a hot pair
+    at lane speed; here each surplus replica is its own candidate.
+    Reference loop: TopicReplicaDistributionGoal.rebalanceForBroker."""
+    B = model.num_brokers
+    T = model.num_topics
+    R = model.num_replicas_padded
+    tbc = model.topic_broker_replica_counts().astype(jnp.float32)  # [T, B]
+    lower_t, upper_t = kernels._topic_limits(model, arrays, constraint)
+    recv = _recv_ok(arrays, options)[None, :]
+    surplus = jnp.ceil(jnp.maximum(tbc - upper_t[:, None], 0.0)).astype(jnp.int32)
+    deficit = jnp.where(recv, jnp.ceil(jnp.maximum(lower_t[:, None] - tbc, 0.0)),
+                        0.0).astype(jnp.int32)
+    # Donors (in-band pairs above the topic midpoint) supply ONLY the
+    # deficit a topic's own surplus cannot cover — an uncapped donor pool
+    # churned ~10x the needed moves toward the midpoints.
+    need_t = jnp.maximum(deficit.sum(axis=1) - surplus.sum(axis=1), 0)  # [T]
+    mid_t = (lower_t + upper_t) * 0.5
+    donor_cap = jnp.floor(jnp.maximum(jnp.minimum(tbc, upper_t[:, None])
+                                      - mid_t[:, None], 0.0)).astype(jnp.int32)
+    # Admit donor capacity greedily (largest donors first) until the
+    # topic's residual need is covered: per-topic prefix over the sorted
+    # capacities, then map the admitted amounts back.
+    d_order = jnp.argsort(-donor_cap, axis=1)                      # [T, B]
+    d_sorted = jnp.take_along_axis(donor_cap, d_order, axis=1)
+    d_cum = jnp.cumsum(d_sorted, axis=1)
+    prev_cum = d_cum - d_sorted
+    admit_sorted = jnp.clip(need_t[:, None] - prev_cum, 0, d_sorted)
+    donor_n = jnp.zeros_like(donor_cap).at[
+        jnp.arange(T)[:, None], d_order].set(admit_sorted)
+    src_n = surplus + donor_n
+    # Destination slots: deficit slots first (the pulls), then spare room
+    # under the upper band for the surplus overflow.
+    spare = jnp.where(recv, jnp.floor(jnp.maximum(
+        upper_t[:, None] - jnp.maximum(tbc, lower_t[:, None]), 0.0)),
+        0.0).astype(jnp.int32)
+
+    # Rank each replica within its (topic, broker) pair.
+    pair = model.replica_topic * B + model.replica_broker          # i32[R]
+    key = jnp.where(model.replica_valid, pair, T * B)
+    order = jnp.argsort(key, stable=True)
+    sorted_key = key[order]
+    start = jnp.searchsorted(sorted_key, jnp.arange(T * B + 1, dtype=sorted_key.dtype))
+    rank_sorted = jnp.arange(R, dtype=jnp.int32) - \
+        start[jnp.minimum(sorted_key, T * B)].astype(jnp.int32)
+    rank = jnp.zeros((R,), jnp.int32).at[order].set(rank_sorted)
+    is_src = model.replica_valid & (rank < src_n.reshape(-1)[jnp.minimum(pair, T * B - 1)])
+
+    relevance = kernels.source_replica_relevance(spec, model, arrays, constraint)
+    rel = jnp.where(is_src, relevance, -jnp.inf)
+    rel_vals, src_replicas = jax.lax.top_k(rel, num_out)           # [S]
+    src_ok = jnp.isfinite(rel_vals)
+
+    # Per-topic slot index of each source (position among sources of the
+    # same topic, by stable sort).
+    t_src = model.replica_topic[src_replicas]
+    t_key = jnp.where(src_ok, t_src, T)
+    s_order = jnp.argsort(t_key, stable=True)
+    s_sorted_t = t_key[s_order]
+    t_start = jnp.searchsorted(s_sorted_t, jnp.arange(T + 1, dtype=s_sorted_t.dtype))
+    p_sorted = jnp.arange(num_out, dtype=jnp.int32) - \
+        t_start[jnp.minimum(s_sorted_t, T)].astype(jnp.int32)
+    p_in_topic = jnp.zeros((num_out,), jnp.int32).at[s_order].set(p_sorted)
+
+    # Topic-major slot table [T, 2B]: each topic's deficit slots (largest
+    # deficits first), then its spare room — one global cumsum + per-topic
+    # base offsets assigns every topic's sources at once.
+    def_order = jnp.argsort(-deficit, axis=1)                      # [T, B]
+    sp_order = jnp.argsort(-spare, axis=1)
+    slot_vals = jnp.concatenate([
+        jnp.take_along_axis(deficit, def_order, axis=1),
+        jnp.take_along_axis(spare, sp_order, axis=1)], axis=1)     # [T, 2B]
+    slot_broker = jnp.concatenate([def_order, sp_order], axis=1)   # [T, 2B]
+    W = 2 * B
+    cum = jnp.cumsum(slot_vals.reshape(-1))                        # [T*W]
+    base = jnp.where(t_src > 0, cum[jnp.maximum(t_src * W - 1, 0)], 0)
+    total_t = cum[t_src * W + W - 1] - base
+    target = base + p_in_topic
+    j = jnp.searchsorted(cum, target, side="right")
+    j = jnp.minimum(j, t_src * W + W - 1)
+    dest1 = slot_broker.reshape(-1)[j]
+    j2 = jnp.minimum(j + 1, t_src * W + W - 1)
+    dest2 = slot_broker.reshape(-1)[j2]
+    dest_ok = src_ok & (p_in_topic < total_t)
+
+    replica = jnp.concatenate([src_replicas, src_replicas])
+    dest = jnp.concatenate([dest1, dest2])
+    ok = jnp.concatenate([dest_ok, dest_ok & (dest2 != dest1)])
+    k = replica.shape[0]
+    action_type = jnp.full((k,), ActionType.INTER_BROKER_REPLICA_MOVEMENT,
+                           jnp.int32)
+    dest_replica = jnp.full((k,), -1, jnp.int32)
+    valid = ok & _legit_move_mask(model, arrays, options, replica, dest)
+    return make_candidates(model, replica, dest, action_type,
+                           dest_replica, valid)
+
+
 def default_num_matched(model: TensorClusterModel, num_sources: int) -> int:
-    """Width of the matched batch: wide enough to cover a whole rung's
-    surplus in a step or two, bounded by the replica axis."""
-    return max(1, min(model.num_replicas_padded, max(16 * num_sources, 4096)))
+    """Width of the matched batch: wide enough to cover a rung's surplus
+    in a couple of steps, but scale-aware — per-step wall grows with K, so
+    small models shouldn't pay a 1M-sized batch (mid-rung surplus ~3k vs
+    a flat 4096 floor doubled the per-step cost for no step win)."""
+    r = model.num_replicas_padded
+    return max(1, min(r, max(256, min(max(2048, r // 4), 16 * num_sources))))
 
 
 def _legit_move_mask(model: TensorClusterModel, arrays: BrokerArrays,

@@ -441,9 +441,9 @@ def select_batched(score: Array, cand: Candidates, eligible: Array,
 
             # Per-key lanes + key-exact repair: the elig budget check bounds
             # ONE candidate at a time, so many lane winners can pile onto a
-            # key with less room.  Admit up to 16 per key (lanes — wide
+            # key with less room.  Admit up to nl per key (lanes — wide
             # enough that a hot pair drains at budget speed), then drop a
-            # violating key's extras down to its single best contributor —
+            # violating key's extras down to its best-fitting prefix —
             # without nuking the whole broker (the broker-stage fallback
             # below stays the last resort for cross-key flips).
             nl = 16
@@ -611,16 +611,36 @@ def _goal_step(model: TensorClusterModel, options: OptimizationOptions,
 
     batches = []
     if spec.uses_moves:
-        batches.append(cgen.move_candidates(spec, model, arrays, constraint, options,
-                                            num_sources, num_dests))
+        # The 1:1 transport-matched batch drains count surpluses at batch
+        # width (see matched_move_candidates); the cross batch stays as
+        # the explorer for pairs the match rejects (sibling / rack
+        # collisions) and shrinks to a quarter width when a matched batch
+        # carries the bulk — at the large rung the full-width cross batch
+        # was pure per-step compute with its winners mostly duplicating
+        # the match.
+        matched = None
         if spec.kind == "replica_distribution":
-            # The 1:1 transport-matched batch drains count surpluses at
-            # batch width (see matched_move_candidates); the cross batch
-            # stays as the explorer for pairs the match rejects (sibling /
-            # rack collisions).
-            batches.append(cgen.matched_move_candidates(
+            matched = cgen.matched_move_candidates(
                 spec, model, arrays, constraint, options,
-                cgen.default_num_matched(model, num_sources)))
+                cgen.default_num_matched(model, num_sources))
+        elif spec.kind == "topic_replica_distribution":
+            # The topic match needs the wider floor: its surplus spreads
+            # over T·B pairs and narrowing the batch to the replica-goal
+            # width grew the fixpoint 20 -> 27 steps at mid.
+            matched = cgen.matched_topic_candidates(
+                spec, model, arrays, constraint, options,
+                max(1, min(model.num_replicas_padded,
+                           max(16 * num_sources, 4096))))
+        # Only the replica-count goal's cross batch shrinks: the topic
+        # goal's matched batch covers band entry but its cross batch still
+        # finds the key-budget-constrained shuffles (shrinking it grew the
+        # fixpoint 18 -> 26 steps at mid).
+        cross_ns = (min(num_sources, max(64, num_sources // 4))
+                    if spec.kind == "replica_distribution" else num_sources)
+        batches.append(cgen.move_candidates(spec, model, arrays, constraint,
+                                            options, cross_ns, num_dests))
+        if matched is not None:
+            batches.append(matched)
     if spec.uses_leadership:
         batches.append(cgen.leadership_candidates(spec, model, arrays, constraint,
                                                   options, num_sources))
@@ -1031,9 +1051,15 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
             prev = prev + chunk
         # Overlap the control-plane fetch with the result arrays the caller
         # will read next (props.diff): async host copies ride the same sync
-        # the packed fetch pays, so the diff's device_get is then free.
+        # the packed fetch pays, so the diff's device_get is then (mostly)
+        # free.  The immutable leaves (partition table, valid masks, loads)
+        # are the same buffers in the initial model — prefetching them here
+        # covers both sides of the diff.
         for arr in (model.replica_broker, model.replica_disk,
-                    model.replica_is_leader):
+                    model.replica_is_leader, model.partition_replicas,
+                    model.replica_valid, model.replica_load_leader,
+                    model.replica_load_follower, model.partition_topic,
+                    model.partition_valid):
             if hasattr(arr, "copy_to_host_async"):
                 arr.copy_to_host_async()
         fetched = jax.device_get(tuple(packed_rows))

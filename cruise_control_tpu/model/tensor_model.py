@@ -319,9 +319,13 @@ class TensorClusterModel:
         bad = (rf > 0) & (leaders != 1)
         if bad.any():
             raise ValueError(f"partitions without exactly one leader: {np.nonzero(bad)[0][:10]}")
-        # No two replicas of one partition on the same broker.
-        pbc = np.asarray(self.partition_broker_counts())
-        if (pbc > 1).any():
+        # No two replicas of one partition on the same broker.  Host-side
+        # int64 pair keys: the dense P×B segment space overflows int32 at
+        # the 7k-broker / 334k-partition scale (P·B ≈ 2.3e9) and would
+        # materialize gigabytes.
+        rp = np.asarray(self.replica_partition)
+        pairs = rp[valid].astype(np.int64) * self.num_brokers + rb[valid]
+        if pairs.size != np.unique(pairs).size:
             raise ValueError("partition has multiple replicas on one broker")
         # Replica's disk must belong to the broker hosting the replica.
         rd = np.asarray(self.replica_disk)

@@ -119,6 +119,33 @@ class SeqState:
         sib = sib[(sib >= 0) & (sib != r)]
         return self.rb[sib]
 
+    def sibling_replicas(self, r):
+        sib = self.part_replicas[self.rp[r]]
+        return sib[(sib >= 0) & (sib != r)]
+
+    # -- leadership transfer (relocateLeadership, ClusterModel.java:406) ---
+    def apply_leadership(self, r_from, r_to):
+        b_from, b_to = self.rb[r_from], self.rb[r_to]
+        d_from = self.load_lead[r_from] - self.load_foll[r_from]
+        d_to = self.load_lead[r_to] - self.load_foll[r_to]
+        self.bload[b_from] -= d_from
+        self.bload[b_to] += d_to
+        self.lcount[b_from] -= 1
+        self.lcount[b_to] += 1
+        self.lbytes[b_from] -= self.load_lead[r_from, 1]
+        self.lbytes[b_to] += self.load_lead[r_to, 1]
+        self.lead[r_from] = False
+        self.lead[r_to] = True
+        self.actions += 1
+
+    # -- pairwise swap (the reference's swap branch,
+    # ResourceDistributionGoal.java:383-440) -------------------------------
+    def apply_swap(self, r1, r2):
+        b1, b2 = self.rb[r1], self.rb[r2]
+        self.apply_move(r1, b2)
+        self.apply_move(r2, b1)
+        self.actions -= 1  # two moves, one balancing action
+
     # -- goal metric / limits ---------------------------------------------
     def metric(self, kind, res):
         if kind in ("capacity", "resource_distribution"):
@@ -203,6 +230,12 @@ def accepts_all(state, prev, r, dest, rl):
         if kind == "rack":
             if (state.sibling_brokers(r) == dest).any():
                 return False
+            # RackAwareGoal.actionAcceptance: the destination RACK must not
+            # already host the partition (round-4 verdict: the move-only
+            # baseline omitted this and later goals un-healed RackAware).
+            if (state.rack[state.sibling_brokers(r)] ==
+                    state.rack[dest]).any():
+                return False
             continue
         if kind == "topic_replica_distribution":
             lo, up = state.topic_limits()
@@ -237,6 +270,156 @@ def delta_for(state, kind, res, r, rl):
     if kind == "leader_bytes_in":
         return state.load_lead[r, 1] if state.lead[r] else 0.0
     return 0.0
+
+
+# Kinds whose metric can be moved by a leadership transfer (the reference
+# tries LEADERSHIP_MOVEMENT for NW_OUT / CPU resource rebalancing and for
+# the leader-count / leader-bytes goals, ResourceDistributionGoal.java:383).
+_LEAD_KINDS = {"leader_replica_distribution", "leader_bytes_in"}
+_LEAD_RES = {0, 2}  # CPU, NW_OUT
+
+
+def _lead_delta(state, kind, res, r):
+    """Metric delta a leadership transfer contributes at replica r's
+    broker (shed when r gives up leadership, gain when it takes it)."""
+    if kind in ("capacity", "resource_distribution"):
+        return (state.load_lead[r] - state.load_foll[r])[res]
+    if kind == "leader_replica_distribution":
+        return 1.0
+    if kind == "leader_bytes_in":
+        return state.load_lead[r, 1]
+    return 0.0
+
+
+def _leadership_applies(kind, res):
+    return kind in _LEAD_KINDS or \
+        (kind in ("capacity", "resource_distribution") and res in _LEAD_RES)
+
+
+def accepts_leadership(state, prev, r_from, r_to):
+    """Cross-goal veto for a leadership transfer (no replica moves, so
+    rack / topic / replica-count goals are unaffected)."""
+    b1, b2 = state.rb[r_from], state.rb[r_to]
+    for (name, kind, res, hard) in prev:
+        # No replica moves, so rack / topic / count goals are unaffected;
+        # only load- and leadership-metric goals can veto.
+        if not _leadership_applies(kind, res) and \
+                kind not in ("capacity", "resource_distribution"):
+            continue
+        m = state.metric(kind, res)
+        lo, up = state.limits(kind, res)
+        d1 = _lead_delta(state, kind, res, r_from)
+        d2 = _lead_delta(state, kind, res, r_to)
+        if m[b2] + d2 > up[b2] + 1e-9:
+            return False
+        if kind not in ("capacity", "leader_bytes_in") and \
+                m[b1] - d1 < lo[b1] - 1e-9:
+            return False
+    return True
+
+
+def accepts_swap(state, prev, r1, r2):
+    """Cross-goal veto for a pairwise swap — BOTH legs evaluated (the
+    round-3 advisor high: one-leg checks let swaps break optimized goals)."""
+    b1, b2 = state.rb[r1], state.rb[r2]
+    for (name, kind, res, hard) in prev:
+        if kind == "rack":
+            for r, dest in ((r1, b2), (r2, b1)):
+                sib = state.sibling_replicas(r)
+                sib = sib[sib != (r2 if r is r1 else r1)]
+                if (state.rb[sib] == dest).any():
+                    return False
+                if (state.rack[state.rb[sib]] == state.rack[dest]).any():
+                    return False
+            continue
+        if kind == "topic_replica_distribution":
+            t1, t2 = state.rt[r1], state.rt[r2]
+            if t1 == t2:
+                continue
+            lo, up = state.topic_limits()
+            if state.tbc[t1, b2] + 1 > up[t1] or \
+               state.tbc[t1, b1] - 1 < lo[t1] or \
+               state.tbc[t2, b1] + 1 > up[t2] or \
+               state.tbc[t2, b2] - 1 < lo[t2]:
+                return False
+            continue
+        m = state.metric(kind, res)
+        lo, up = state.limits(kind, res)
+        rl1, rl2 = state.rload()[r1], state.rload()[r2]
+        d1 = delta_for(state, kind, res, r1, rl1)
+        d2 = delta_for(state, kind, res, r2, rl2)
+        net1 = -d1 + d2  # at b1
+        net2 = d1 - d2   # at b2
+        for b, net in ((b1, net1), (b2, net2)):
+            if m[b] + net > up[b] + 1e-9:
+                return False
+            if kind not in ("capacity", "replica_capacity",
+                            "potential_nw_out", "leader_bytes_in") and \
+                    m[b] + net < lo[b] - 1e-9:
+                return False
+    return True
+
+
+def try_leadership(state, kind, res, r, prev):
+    """First-improvement leadership transfer off replica r's broker."""
+    if not state.lead[r]:
+        return False
+    m = state.metric(kind, res)
+    _, up = state.limits(kind, res)
+    d1 = _lead_delta(state, kind, res, r)
+    if d1 <= 0:
+        return False
+    for r2 in state.sibling_replicas(r):
+        state.plans_scored += 1
+        b2 = state.rb[r2]
+        d2 = _lead_delta(state, kind, res, r2)
+        if m[b2] + d2 > up[b2] + 1e-9:
+            continue
+        if not accepts_leadership(state, prev, r, r2):
+            continue
+        state.apply_leadership(r, r2)
+        return True
+    return False
+
+
+def try_swap(state, kind, res, r1, prev, max_dests=8, max_partners=24):
+    """First-improvement pairwise swap: r1 (large, over broker) for a
+    smaller replica on an under-loaded broker
+    (ResourceDistributionGoal.java:383-440 swap branch)."""
+    src = state.rb[r1]
+    rload = state.rload()
+    m = state.metric(kind, res)
+    lo, up = state.limits(kind, res)
+    d1 = delta_for(state, kind, res, r1, rload[r1])
+    if d1 <= 0:
+        return False
+    col = res if res >= 0 else 3
+    dests = np.argsort(m / np.maximum(state.cap[:, col], 1e-9))
+    sib1 = set(state.sibling_brokers(r1).tolist())
+    tried_dests = 0
+    for dest in dests:
+        if dest == src or dest in sib1:
+            continue
+        tried_dests += 1
+        if tried_dests > max_dests:
+            break
+        cands = np.nonzero(state.valid & (state.rb == dest))[0]
+        key = rload[cands, col]
+        cands = cands[np.argsort(key)][:max_partners]
+        for r2 in cands:
+            state.plans_scored += 1
+            d2 = delta_for(state, kind, res, r2, rload[r2])
+            if d2 >= d1:  # must net-shed from the over broker
+                continue
+            if (state.rb[state.sibling_replicas(r2)] == src).any():
+                continue
+            if m[dest] - d2 + d1 > up[dest] + 1e-9:
+                continue
+            if not accepts_swap(state, prev, r1, r2):
+                continue
+            state.apply_swap(r1, r2)
+            return True
+    return False
 
 
 def optimize_goal(state, name, kind, res, prev):
@@ -304,6 +487,19 @@ def optimize_goal(state, name, kind, res, prev):
                     applied += 1
                     moved = True
                     break
+                # Action-family parity with the reference's rebalance loop:
+                # when no replica move applies, try a leadership transfer,
+                # then a pairwise swap (ResourceDistributionGoal.java:383-440).
+                if not moved and kind != "rack" and \
+                        _leadership_applies(kind, res) and \
+                        try_leadership(state, kind, res, r, prev):
+                    applied += 1
+                    moved = True
+                if not moved and kind in ("resource_distribution", "capacity",
+                                          "leader_bytes_in") and \
+                        try_swap(state, kind, res, r, prev):
+                    applied += 1
+                    moved = True
                 if moved and kind != "rack":
                     m = state.metric(kind, res)
                     lo, up = state.limits(kind, res)
