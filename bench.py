@@ -45,6 +45,7 @@ SCALES = {
     # remote-compile service hangs on 1M-replica shapes; these binary-search
     # the largest shape that compiles — round-4 verdict weak #3).
     "xl250": (1000, 40, 200, 417.0, 3),   # ~250k replicas
+    "xl375": (1000, 40, 200, 625.0, 3),   # ~375k replicas
     "xl500": (1000, 40, 200, 833.0, 3),   # ~500k replicas
     "xl750": (1000, 40, 200, 1250.0, 3),  # ~750k replicas
     "xl": (1000, 40, 200, 1667.0, 3),   # stretch rung toward 7k/1M

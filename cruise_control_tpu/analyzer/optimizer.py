@@ -969,6 +969,14 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
     if max_candidates_per_step:
         ns = max(1, min(ns, max_candidates_per_step))
         nd = max(1, min(nd, max_candidates_per_step // ns))
+    elif num_dests is None and ns * nd > 32_768:
+        # Remote-compile ceiling: the tunneled TPU's compile service hangs
+        # on S×D cross batches beyond ~32k candidates (256k-wide programs
+        # at 1000 brokers hung for two rounds; the same shape compiled and
+        # ran in 22.6 s once K was capped — round-5 probe, BASELINE.md).
+        # The transport-matched batches carry dest assignment for the
+        # count goals, so narrow cross dests no longer throttle them.
+        nd = max(8, 32_768 // ns)
     scored = 0
 
     def k_of(spec: GoalSpec) -> int:
