@@ -581,16 +581,38 @@ def _topic_budgets(all_specs: Tuple[GoalSpec, ...], model: TensorClusterModel,
     return gain_rep, shed_rep, shed_lead
 
 
+# The tunneled TPU's remote-compile service hangs on S×D cross batches
+# beyond roughly this many candidates (probed round 5: 256k-wide programs
+# at 1000 brokers hung for two rounds; the same shapes compile and run
+# once capped — BASELINE.md).  The ceiling binds ONLY on the tpu backend:
+# CPU / virtual-mesh runs compile 1M-shape programs in seconds and need
+# the wide dest sets (nd=16 at 7k brokers starves the usage-distribution
+# goals' exploration).
+_COMPILE_CEILING_K = 32_768
+
+
+def _cross_ceiling_k() -> Optional[int]:
+    try:
+        return _COMPILE_CEILING_K if jax.default_backend() == "tpu" else None
+    except Exception:  # noqa: BLE001 — backend probing must never fail a run
+        return None
+
+
 def _goal_num_sources(spec: GoalSpec, model: TensorClusterModel,
-                      num_sources: int) -> int:
+                      num_sources: int, num_dests: int) -> int:
     """Per-goal source-width policy.  Rack healing is purely source-bound
     (every conflicted replica is one independent fix; the mid rung spent 5
     steps draining 699 conflicts 140-at-a-time through ns=200), so it gets
     a wide batch; band goals keep the configured width — their throughput
     is budget- and lane-bound, and wider cross batches measurably hurt
-    (round-5 sweep: ns=512 at mid grew the stack 78 -> 95 steps)."""
+    (round-5 sweep: ns=512 at mid grew the stack 78 -> 95 steps).  The
+    widened batch still respects the tunneled-TPU compile ceiling."""
     if spec.kind in ("rack", "rack_distribution"):
-        return max(1, min(model.num_replicas_padded, max(4 * num_sources, 1024)))
+        ns = max(1, min(model.num_replicas_padded, max(4 * num_sources, 1024)))
+        ceiling = _cross_ceiling_k()
+        if ceiling is not None:
+            ns = max(num_sources, min(ns, ceiling // max(num_dests, 1)))
+        return ns
     return num_sources
 
 
@@ -607,7 +629,7 @@ def _goal_step(model: TensorClusterModel, options: OptimizationOptions,
     parallel/mesh.py).
     """
     arrays = BrokerArrays.from_model(model)
-    num_sources = _goal_num_sources(spec, model, num_sources)
+    num_sources = _goal_num_sources(spec, model, num_sources, num_dests)
 
     batches = []
     if spec.uses_moves:
@@ -668,11 +690,25 @@ def _goal_step(model: TensorClusterModel, options: OptimizationOptions,
             lambda x: jax.lax.with_sharding_constraint(x, sharding), cand)
 
     feasible = kernels.self_feasible(spec, model, arrays, cand, constraint)
-    # Band-kind prev goals' vetoes batch into one stacked mask chain; the
-    # structural kinds (rack, topic counts, min-leaders, intra-disk) keep
-    # their dedicated accepts.
-    accepted = kernels.accepts_band_batch(prev_specs, model, arrays, cand,
-                                          constraint)
+    # Band-kind prev goals' vetoes are fully subsumed by the channel
+    # budgets below: room_dest/slack_src are built from the SAME
+    # limits()/delta math over all_specs, and select_batched's per-candidate
+    # eligibility check (cum = 0 in round 1) equals the per-candidate band
+    # bounds.  Verified empirically: the full 15-goal mid stack produces
+    # identical proposal sets with the per-spec band mask chain removed —
+    # which deletes ~2 serial mask chains per optimized goal from the
+    # per-step op chain (the late-stack goals carried 10+).  Structural
+    # kinds (rack, topic counts, min-leaders, intra-disk) keep their
+    # dedicated accepts.
+    if _DBG_NO_BUDGETS:
+        # The budget ablation must not silently drop band enforcement too:
+        # with budgets off, the per-spec band mask chain is the band check
+        # (and doubles as the production oracle for the equivalence —
+        # tests/test_optimizer.py::test_band_budgets_subsume_band_accepts).
+        accepted = kernels.accepts_band_batch(prev_specs, model, arrays, cand,
+                                              constraint)
+    else:
+        accepted = jnp.ones(cand.k, bool)
     for prev in prev_specs:
         if not kernels.is_band_kind(prev):
             accepted = accepted & kernels.accepts(prev, model, arrays, cand,
@@ -951,6 +987,7 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
     constraint = constraint or BalancingConstraint.default()
     options = options if options is not None else OptimizationOptions.none(model)
     specs = goals_by_priority(goal_names)
+    dests_pinned = num_dests is not None
     if fast_mode:
         num_sources = min(max(32, (num_sources or cgen.default_num_sources(model)) // 2),
                           model.num_replicas_padded)
@@ -969,14 +1006,19 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
     if max_candidates_per_step:
         ns = max(1, min(ns, max_candidates_per_step))
         nd = max(1, min(nd, max_candidates_per_step // ns))
-    elif num_dests is None and ns * nd > 32_768:
-        # Remote-compile ceiling: the tunneled TPU's compile service hangs
-        # on S×D cross batches beyond ~32k candidates (256k-wide programs
-        # at 1000 brokers hung for two rounds; the same shape compiled and
-        # ran in 22.6 s once K was capped — round-5 probe, BASELINE.md).
-        # The transport-matched batches carry dest assignment for the
-        # count goals, so narrow cross dests no longer throttle them.
-        nd = max(8, 32_768 // ns)
+    ceiling = _cross_ceiling_k()
+    if ceiling is not None and not dests_pinned and ns * nd > ceiling:
+        # Remote-compile ceiling (see _COMPILE_CEILING_K): applies on the
+        # tunneled TPU backend whenever the caller didn't pin the dest
+        # width explicitly — including fast_mode, whose halved widths at
+        # 1000 brokers still exceeded the ceiling.  The transport-matched
+        # batches carry dest assignment for the count goals, so narrow
+        # cross dests no longer throttle them.  Shrink nd first, then ns,
+        # so the invariant ns*nd <= ceiling holds even for wide explicit
+        # num_sources.
+        nd = max(8, ceiling // ns)
+        if ns * nd > ceiling:
+            ns = max(64, ceiling // nd)
     scored = 0
 
     def k_of(spec: GoalSpec) -> int:

@@ -198,3 +198,55 @@ def test_batched_band_accepts_matches_per_spec():
             folded = folded & kernels.accepts(s, model, arrays, batch, constraint)
         batched = kernels.accepts_band_batch(prev, model, arrays, batch, constraint)
         np.testing.assert_array_equal(np.asarray(batched), np.asarray(folded))
+
+
+def test_band_budgets_subsume_band_accepts():
+    """round-5 load-bearing equivalence: the per-candidate band vetoes of
+    previously-optimized goals are enforced by select_batched's channel
+    budgets (room_dest / slack_src over all_specs), so the production path
+    skips the per-spec accepts_band_batch chain.  Every action an in-stack
+    step APPLIES must still satisfy the accepts fold of every prev band
+    goal — accepts_band_batch is kept as the oracle (and as the band check
+    under the _DBG_NO_BUDGETS ablation)."""
+    import jax.numpy as jnp
+
+    from cruise_control_tpu.analyzer import candidates as cgen
+    from cruise_control_tpu.analyzer.actions import ActionType, make_candidates
+    from cruise_control_tpu.analyzer.goals import kernels
+    from cruise_control_tpu.analyzer.goals.specs import goals_by_priority
+    from cruise_control_tpu.analyzer.state import BrokerArrays
+
+    spec_m = ClusterSpec(num_brokers=12, num_racks=4, num_topics=6,
+                         mean_partitions_per_topic=20.0, replication_factor=2,
+                         distribution="exponential", seed=11)
+    model = generate_cluster(spec_m)
+    con = BalancingConstraint.default()
+    options = OptimizationOptions.none(model)
+    ns, nd = cgen.default_num_sources(model), cgen.default_num_dests(model)
+
+    # Optimize the hard prefix, then take ONE ReplicaDistribution step and
+    # check its applied actions against the prev goals' band accepts.
+    prev = tuple(goals_by_priority(DEFAULT_STACK[:6]))
+    m = model
+    for i, g in enumerate(prev):
+        fix = opt._get_fixpoint_fn(g, prev[:i], con, ns, nd, 256)
+        m = fix(m, options)[0]
+    g = goals_by_priority(["ReplicaDistributionGoal"])[0]
+    step = opt._get_step_fn(g, prev, con, ns, nd)
+    new_m, n = step(m, options)
+    assert int(n) > 0
+
+    rb0 = np.asarray(m.replica_broker)
+    rb1 = np.asarray(new_m.replica_broker)
+    moved = np.nonzero(rb0 != rb1)[0]
+    assert moved.size > 0
+    replica = jnp.asarray(moved, jnp.int32)
+    dest = jnp.asarray(rb1[moved], jnp.int32)
+    k = int(replica.shape[0])
+    cand = make_candidates(
+        m, replica, dest,
+        jnp.full((k,), ActionType.INTER_BROKER_REPLICA_MOVEMENT, jnp.int32),
+        jnp.full((k,), -1, jnp.int32), jnp.ones((k,), bool))
+    arrays = BrokerArrays.from_model(m)
+    ok = np.asarray(kernels.accepts_band_batch(prev, m, arrays, cand, con))
+    assert ok.all(), "an applied action violates a prev goal's band accepts"
