@@ -114,6 +114,7 @@ def matched_move_candidates(spec: GoalSpec, model: TensorClusterModel,
     """
     B = model.num_brokers
     R = model.num_replicas_padded
+    num_out = max(1, min(num_out, R))
     metric = kernels.broker_metric(spec, model, arrays, constraint)  # f32[B]
     lower, upper = kernels.limits(spec, model, arrays, constraint)
     # Shed target: down to the upper band normally; down to the band
@@ -192,6 +193,7 @@ def matched_topic_candidates(spec: GoalSpec, model: TensorClusterModel,
     B = model.num_brokers
     T = model.num_topics
     R = model.num_replicas_padded
+    num_out = max(1, min(num_out, R))
     tbc = model.topic_broker_replica_counts().astype(jnp.float32)  # [T, B]
     lower_t, upper_t = kernels._topic_limits(model, arrays, constraint)
     recv = _recv_ok(arrays, options)[None, :]
