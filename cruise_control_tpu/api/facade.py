@@ -19,6 +19,7 @@ This facade is also the self-healing context consumed by
 from __future__ import annotations
 
 import dataclasses
+import functools
 import re
 import threading
 import time
@@ -26,6 +27,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np
+
+from cruise_control_tpu.common.sensors import SENSORS
+from cruise_control_tpu.common.tracing import TRACE
 
 from cruise_control_tpu.analyzer import optimizer as opt
 from cruise_control_tpu.analyzer import proposals as props
@@ -85,6 +89,27 @@ class OperationResult:
         if self.execution is not None:
             out["execution"] = dataclasses.asdict(self.execution)
         return out
+
+
+def _traced_op(fn):
+    """Wrap an admin operation in a ``facade.<op>`` span.  Under a user
+    task this nests below the task's ``request.<endpoint>`` root; called
+    directly (tests, self-healing fixes) it becomes its own root trace."""
+    name = f"facade.{fn.__name__}"
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        attrs = {k: kwargs[k] for k in ("dryrun", "reason", "self_healing")
+                 if k in kwargs}
+        with TRACE.span(name, **attrs) as sp:
+            out = fn(self, *args, **kwargs)
+            if isinstance(out, OperationResult):
+                sp.annotate(ok=out.ok, proposals=len(out.proposals))
+            elif isinstance(out, bool):
+                sp.annotate(ok=out)
+            return out
+
+    return wrapper
 
 
 class CruiseControl:
@@ -235,11 +260,12 @@ class CruiseControl:
             # not just /rebalance (the reference applies them in all
             # GoalBasedOperationRunnables).
             options = self._base_options(model, naming)
-        from cruise_control_tpu.common.sensors import SENSORS
         # Requested non-hard-only goal subsets still honor hard goals first
         # (GoalBasedOperationRunnable skip-hard-goal-check semantics are an
         # explicit flag in the reference; default keeps them).
-        with SENSORS.timer("GoalOptimizer.proposal-computation-timer").time():
+        with SENSORS.timer(
+                "GoalOptimizer.proposal-computation-timer",
+                help="End-to-end goal-stack optimization wall time").time():
             return opt.optimize(model, goal_list, constraint=self.constraint,
                                 options=options, raise_on_hard_failure=False,
                                 fused=True, fast_mode=fast_mode,
@@ -256,27 +282,32 @@ class CruiseControl:
         # everything leaving the facade — REST payloads and the executor's
         # ReassignmentRequests / throttle entries — carries cluster ids from
         # the SAME snapshot the model was built from.
-        dense_proposals = props.diff(model, run.model)
-        capped = [g.name for g in run.goal_results if g.capped]
-        if verify:
-            try:
-                verify_run(model, run, [g.name for g in run.goal_results],
-                           constraint=self.constraint, proposals=dense_proposals)
-            except VerificationError as e:
-                return OperationResult(
-                    ok=False, dryrun=dryrun,
-                    proposals=props.renumber_brokers(
-                        dense_proposals, naming["brokers"]),
-                    violated_goals_before=run.violated_goals_before,
-                    violated_goals_after=run.violated_goals_after,
-                    provision_status=run.provision_response.status.value,
-                    stats_before=run.stats_before.to_dict(),
-                    stats_after=run.stats_after.to_dict(),
-                    reason=f"{reason} [verification failed: {e}]",
-                    capped_goals=capped,
-                    balancedness_before=run.balancedness_before,
-                    balancedness_after=run.balancedness_after)
-        proposals = props.renumber_brokers(dense_proposals, naming["brokers"])
+        with TRACE.span("analyzer.proposals", verify=verify) as sp:
+            dense_proposals = props.diff(model, run.model)
+            capped = [g.name for g in run.goal_results if g.capped]
+            if verify:
+                try:
+                    verify_run(model, run, [g.name for g in run.goal_results],
+                               constraint=self.constraint,
+                               proposals=dense_proposals)
+                except VerificationError as e:
+                    sp.annotate(verification_failed=True)
+                    return OperationResult(
+                        ok=False, dryrun=dryrun,
+                        proposals=props.renumber_brokers(
+                            dense_proposals, naming["brokers"]),
+                        violated_goals_before=run.violated_goals_before,
+                        violated_goals_after=run.violated_goals_after,
+                        provision_status=run.provision_response.status.value,
+                        stats_before=run.stats_before.to_dict(),
+                        stats_after=run.stats_after.to_dict(),
+                        reason=f"{reason} [verification failed: {e}]",
+                        capped_goals=capped,
+                        balancedness_before=run.balancedness_before,
+                        balancedness_after=run.balancedness_after)
+            proposals = props.renumber_brokers(dense_proposals,
+                                               naming["brokers"])
+            sp.annotate(proposals=len(proposals))
         execution = None
         ok = True
         if not dryrun and proposals:
@@ -302,6 +333,7 @@ class CruiseControl:
     # ------------------------------------------------------------------
     # Proposals (cached)
     # ------------------------------------------------------------------
+    @_traced_op
     def proposals(self, goals: Optional[Sequence[str]] = None,
                   ignore_proposal_cache: bool = False,
                   excluded_topics_pattern: Optional[str] = None
@@ -350,6 +382,7 @@ class CruiseControl:
     # ------------------------------------------------------------------
     # Admin operations (also the self-healing context SPI)
     # ------------------------------------------------------------------
+    @_traced_op
     def rebalance(self, goals: Optional[Sequence[str]] = None, dryrun: bool = False,
                   destination_broker_ids: Optional[Sequence[int]] = None,
                   excluded_topics: Optional[Sequence[int]] = None,
@@ -387,6 +420,7 @@ class CruiseControl:
                             strategy=strategy,
                             replication_throttle=replication_throttle)
 
+    @_traced_op
     def add_brokers(self, broker_ids: Sequence[int], dryrun: bool = False,
                     reason: str = "add_brokers",
                     excluded_topics_pattern: Optional[str] = None,
@@ -404,6 +438,7 @@ class CruiseControl:
                             strategy=strategy,
                             replication_throttle=replication_throttle)
 
+    @_traced_op
     def remove_brokers(self, broker_ids: Sequence[int], dryrun: bool = False,
                        reason: str = "remove_brokers",
                        self_healing: bool = False,
@@ -427,6 +462,7 @@ class CruiseControl:
             self.executor.add_recently_removed_brokers(list(broker_ids))
         return result.ok
 
+    @_traced_op
     def demote_brokers(self, broker_ids: Sequence[int], dryrun: bool = False,
                        reason: str = "demote_brokers") -> bool:
         """Transfer ALL leadership off the brokers (DemoteBrokerRunnable →
@@ -479,6 +515,7 @@ class CruiseControl:
                     break
         return count
 
+    @_traced_op
     def fix_offline_replicas(self, dryrun: bool = False,
                              reason: str = "fix_offline_replicas",
                              self_healing: bool = False) -> bool:
@@ -491,6 +528,7 @@ class CruiseControl:
         run = self._optimize(model, self.hard_goals, options)
         return self._finish(model, run, dryrun, reason, naming).ok
 
+    @_traced_op
     def update_topic_replication_factor(self, topics_rf: Dict[str, int],
                                         dryrun: bool = False,
                                         reason: str = "topic_rf_update") -> bool:
@@ -560,8 +598,12 @@ class CruiseControl:
         }
         if detector_manager is not None:
             out["AnomalyDetectorState"] = detector_manager.state_dict()
-        from cruise_control_tpu.common.sensors import SENSORS
-        out["Sensors"] = SENSORS.snapshot()
+        sensors = SENSORS.snapshot()
+        # Per-operation trace rollup (count/totalMs/maxMs by root span name)
+        # rides inside the Sensors block so /state answers "where does a
+        # rebalance spend its time" without a separate /trace query.
+        sensors["traces"] = TRACE.rollup()
+        out["Sensors"] = sensors
         return out
 
     def kafka_cluster_state(self) -> Dict[str, object]:

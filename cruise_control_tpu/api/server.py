@@ -39,13 +39,15 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 from cruise_control_tpu.api.facade import CruiseControl
 from cruise_control_tpu.api.purgatory import Purgatory
 from cruise_control_tpu.api.user_tasks import TaskStatus, UserTaskManager
+from cruise_control_tpu.common.sensors import SENSORS
+from cruise_control_tpu.common.tracing import TRACE
 from cruise_control_tpu.detector.anomalies import AnomalyType
 
 PREFIX = "/kafkacruisecontrol"
 
 GET_ENDPOINTS = {"bootstrap", "train", "load", "partition_load", "proposals",
                  "state", "kafka_cluster_state", "user_tasks", "review_board",
-                 "metrics"}
+                 "metrics", "trace"}
 POST_ENDPOINTS = {"add_broker", "remove_broker", "fix_offline_replicas",
                   "rebalance", "stop_proposal_execution", "pause_sampling",
                   "resume_sampling", "demote_broker", "admin", "review",
@@ -191,12 +193,34 @@ class CruiseControlApi:
     # -- dispatch ----------------------------------------------------------
     def handle(self, method: str, endpoint: str, query: Dict[str, str],
                headers=None) -> Tuple[int, Dict[str, object], Dict[str, str]]:
-        """Returns (http_status, json_body, extra_headers)."""
+        """Returns (http_status, json_body, extra_headers).  Every request
+        to a known endpoint is metered: a latency histogram and a
+        status-code counter, both labeled by endpoint (the reference's
+        successful-request-execution-timer per endpoint,
+        KafkaCruiseControlServlet.java)."""
         endpoint = endpoint.lower()
         valid = GET_ENDPOINTS if method == "GET" else POST_ENDPOINTS
         if endpoint not in valid:
+            # Unknown endpoints are NOT metered — arbitrary request paths
+            # would make the label set unbounded.
             return 404, {"error": f"unknown {method} endpoint {endpoint!r}",
                          "validEndpoints": sorted(valid)}, {}
+        t0 = time.monotonic()
+        status, body, extra = self._handle(method, endpoint, query, headers)
+        SENSORS.histogram(
+            "webserver.request-duration-seconds",
+            labels={"endpoint": endpoint},
+            help="Wall time spent handling an API request, by endpoint",
+        ).observe(time.monotonic() - t0)
+        SENSORS.counter(
+            "webserver.responses-total",
+            labels={"endpoint": endpoint, "code": status},
+            help="API responses by endpoint and HTTP status code",
+        ).inc()
+        return status, body, extra
+
+    def _handle(self, method: str, endpoint: str, query: Dict[str, str],
+                headers=None) -> Tuple[int, Dict[str, object], Dict[str, str]]:
         role = self.security.authenticate(headers or {})
         if role is None:
             # Challenge-based schemes (SPNEGO's Negotiate) advertise the
@@ -297,10 +321,37 @@ class CruiseControlApi:
         """Sensor registry (Sensors.md): JSON by default; Prometheus
         exposition text with ?format=prometheus (the /metrics surface the
         reference exports via JMX)."""
-        from cruise_control_tpu.common.sensors import SENSORS
         if q.get("format") == "prometheus":
             return 200, PlainText(SENSORS.prometheus_text()), {}
         return 200, SENSORS.snapshot(), {}
+
+    def _ep_trace(self, q):
+        """Finished operation traces.  ``?task_id=`` returns the span tree
+        attached to that user task; ``?trace_id=`` looks up the global ring
+        buffer; with neither, lists recent root traces."""
+        task_id = q.get("task_id")
+        if task_id:
+            task = self.user_tasks.get(task_id)
+            if task is None:
+                return 404, {"error": f"unknown task_id {task_id!r}"}, {}
+            if task.trace is None:
+                if task.status == TaskStatus.ACTIVE:
+                    return 202, {"userTaskId": task.task_id,
+                                 "status": task.status,
+                                 "message": "trace not finished yet"}, {}
+                return 404, {"error": f"no trace recorded for task "
+                                      f"{task_id!r}"}, {}
+            return 200, {"userTaskId": task.task_id, "status": task.status,
+                         "trace": task.trace}, {}
+        trace_id = q.get("trace_id")
+        if trace_id:
+            t = TRACE.get(trace_id)
+            if t is None:
+                return 404, {"error": f"unknown trace_id {trace_id!r}"}, {}
+            return 200, {"trace": t}, {}
+        limit = int(q.get("limit", "20"))
+        return 200, {"traces": TRACE.recent(limit),
+                     "rollup": TRACE.rollup()}, {}
 
     def _ep_load(self, q):
         def fn(progress):
@@ -542,6 +593,7 @@ _INDEX_HTML = """<!doctype html>
  <a href="%PREFIX%/kafka_cluster_state">kafka_cluster_state</a>
  <a href="%PREFIX%/proposals">proposals</a>
  <a href="%PREFIX%/metrics">metrics</a>
+ <a href="%PREFIX%/trace">trace</a>
  <a href="%PREFIX%/user_tasks">user_tasks</a>
 </div>
 <h2>State</h2><pre id="state">loading…</pre>

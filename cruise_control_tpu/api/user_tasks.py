@@ -16,6 +16,8 @@ import time
 import uuid
 from typing import Callable, Dict, List, Optional, Tuple
 
+from cruise_control_tpu.common.tracing import TRACE
+
 
 @dataclasses.dataclass
 class OperationStep:
@@ -70,6 +72,9 @@ class UserTask:
     result: Optional[object] = None
     error: Optional[str] = None
     end_ms: int = -1
+    #: Finished span tree for this operation (set when the worker thread
+    #: completes); served by GET /trace?task_id=<task_id>.
+    trace: Optional[Dict[str, object]] = None
 
     def summary(self) -> Dict[str, object]:
         return {"UserTaskId": self.task_id, "RequestURL": self.endpoint,
@@ -137,15 +142,22 @@ class UserTaskManager:
             self._by_key[request_key] = task.task_id
 
         def run():
-            try:
-                task.result = fn(task.progress)
-                task.status = TaskStatus.COMPLETED
-            except Exception as e:  # noqa: BLE001 — surfaced via the API
-                task.error = f"{type(e).__name__}: {e}"
-                task.status = TaskStatus.COMPLETED_WITH_ERROR
-            finally:
-                task.progress.finish()
-                task.end_ms = int(time.time() * 1000)
+            # The worker thread has an empty span stack, so this span is the
+            # trace ROOT; every span the operation opens (facade → monitor →
+            # analyzer → executor) nests under it.
+            with TRACE.span(f"request.{endpoint}", task_id=task.task_id) as sp:
+                try:
+                    task.result = fn(task.progress)
+                    task.status = TaskStatus.COMPLETED
+                except Exception as e:  # noqa: BLE001 — surfaced via the API
+                    task.error = f"{type(e).__name__}: {e}"
+                    task.status = TaskStatus.COMPLETED_WITH_ERROR
+                finally:
+                    task.progress.finish()
+                    task.end_ms = int(time.time() * 1000)
+                    sp.annotate(status=task.status)
+            if sp.trace_id is not None:
+                task.trace = TRACE.get(sp.trace_id)
 
         threading.Thread(target=run, name=f"user-task-{task.task_id[:8]}",
                          daemon=True).start()

@@ -22,6 +22,8 @@ import threading
 from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from cruise_control_tpu.common.sensors import SENSORS
+from cruise_control_tpu.common.tracing import TRACE
 from cruise_control_tpu.detector.anomalies import Anomaly, AnomalyType
 from cruise_control_tpu.detector.notifier import (AnomalyNotificationAction,
                                                   AnomalyNotifier, SelfHealingNotifier)
@@ -138,9 +140,16 @@ class AnomalyDetectorManager:
             if last is not None and now_ms - last < interval:
                 continue
             entry[2] = now_ms
-            result = detector.detect(now_ms)
-            anomalies = result if isinstance(result, list) else \
-                ([result] if result is not None else [])
+            kind = type(detector).__name__
+            hist = SENSORS.histogram(
+                "AnomalyDetector.detection-duration-seconds",
+                labels={"detector": kind},
+                help="Wall time spent in each detector's detect() call")
+            with TRACE.span("detector.detect", detector=kind) as sp, hist.time():
+                result = detector.detect(now_ms)
+                anomalies = result if isinstance(result, list) else \
+                    ([result] if result is not None else [])
+                sp.annotate(anomalies=len(anomalies))
             for a in anomalies:
                 self.enqueue(a, now_ms)
                 found += 1
@@ -163,9 +172,9 @@ class AnomalyDetectorManager:
         return handled
 
     def _handle(self, anomaly: Anomaly, now_ms: int) -> int:
-        from cruise_control_tpu.common.sensors import SENSORS
         SENSORS.counter(
-            f"AnomalyDetector.{type(anomaly).__name__}-rate").inc()
+            f"AnomalyDetector.{type(anomaly).__name__}-rate",
+            help="Anomalies of this type handled by the notifier").inc()
         result = self._notifier.on_anomaly(anomaly, now_ms)
         if result.action == AnomalyNotificationAction.IGNORE:
             self.state.update_status(anomaly, "IGNORED", now_ms)

@@ -23,9 +23,12 @@ import dataclasses
 import enum
 import threading
 import time
+from contextlib import contextmanager
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.common.sensors import SENSORS
+from cruise_control_tpu.common.tracing import TRACE
 from cruise_control_tpu.executor.admin import ClusterAdmin, ReassignmentRequest, Tp
 from cruise_control_tpu.executor.planner import ExecutionPlan, ExecutionTaskPlanner
 from cruise_control_tpu.executor.strategy import ReplicaMovementStrategy, StrategyContext
@@ -160,7 +163,6 @@ class Executor:
         self._adjuster = ConcurrencyAdjuster(self._limits, *self._adjuster_args)
         # Sensor registrations (Executor.registerGaugeSensors,
         # Executor.java:271; Sensors.md execution gauges).
-        from cruise_control_tpu.common.sensors import SENSORS
         from cruise_control_tpu.executor.task import TaskType as _TT
 
         def _in_progress(task_type):
@@ -174,17 +176,29 @@ class Executor:
             return read
 
         SENSORS.gauge("Executor.inter-broker-partition-movements-in-progress",
-                      _in_progress(_TT.INTER_BROKER_REPLICA_ACTION))
+                      _in_progress(_TT.INTER_BROKER_REPLICA_ACTION),
+                      help="Inter-broker replica movements currently in flight")
         SENSORS.gauge("Executor.intra-broker-partition-movements-in-progress",
-                      _in_progress(_TT.INTRA_BROKER_REPLICA_ACTION))
+                      _in_progress(_TT.INTRA_BROKER_REPLICA_ACTION),
+                      help="Intra-broker (logdir) movements currently in flight")
         SENSORS.gauge("Executor.leadership-movements-in-progress",
-                      _in_progress(_TT.LEADER_ACTION))
+                      _in_progress(_TT.LEADER_ACTION),
+                      help="Leadership transfers currently in flight")
         SENSORS.gauge("Executor.execution-in-progress",
-                      lambda: float(self.has_ongoing_execution))
-        self._sensor_started = SENSORS.counter("Executor.executions-started")
-        self._sensor_stopped = SENSORS.counter("Executor.executions-stopped")
-        self._sensor_completed = SENSORS.counter("Executor.tasks-completed")
-        self._sensor_dead = SENSORS.counter("Executor.tasks-dead")
+                      lambda: float(self.has_ongoing_execution),
+                      help="1 while a proposal execution is running")
+        self._sensor_started = SENSORS.counter(
+            "Executor.executions-started",
+            help="Proposal executions started since boot")
+        self._sensor_stopped = SENSORS.counter(
+            "Executor.executions-stopped",
+            help="Proposal executions stopped by user request")
+        self._sensor_completed = SENSORS.counter(
+            "Executor.tasks-completed",
+            help="Execution tasks finished in COMPLETED state")
+        self._sensor_dead = SENSORS.counter(
+            "Executor.tasks-dead",
+            help="Execution tasks abandoned in DEAD state")
 
     # -- state -------------------------------------------------------------
     def state(self) -> ExecutorState:
@@ -286,6 +300,15 @@ class Executor:
                              self._demoted_retention_ms)
             return set(self._recently_demoted)
 
+    @contextmanager
+    def _phase_probe(self, phase: str, tasks: int):
+        """Span + duration histogram around one execution phase."""
+        hist = SENSORS.histogram(
+            "Executor.phase-duration-seconds", labels={"phase": phase},
+            help="Wall time spent in each execution phase")
+        with TRACE.span(f"executor.{phase}", tasks=tasks), hist.time():
+            yield
+
     # -- main entry ----------------------------------------------------------
     def execute_proposals(self, proposals: Sequence[ExecutionProposal],
                           partition_names: Sequence[Tp],
@@ -337,45 +360,58 @@ class Executor:
             polls = 0
             stopped = False
 
-            # Phase 1: inter-broker replica movement (throttled).
-            if plan.inter_broker_tasks and not stopped:
-                with self._lock:
-                    self._state = ExecutorState.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
-                involved = sorted({b for t in plan.inter_broker_tasks
-                                   for b in t.brokers_involved()})
-                throttle.set_throttles(plan.inter_broker_tasks, partition_names)
-                try:
-                    polls, stopped = self._run_inter_broker_phase(
-                        tm, partition_names, max_polls, poll_interval_s,
-                        concurrency_adjust_metrics)
-                finally:
-                    throttle.clear_throttles(plan.inter_broker_tasks,
-                                             partition_names)
+            with TRACE.span("executor.execute", proposals=len(proposals),
+                            inter_broker_tasks=len(plan.inter_broker_tasks),
+                            intra_broker_tasks=len(plan.intra_broker_tasks),
+                            leadership_tasks=len(plan.leadership_tasks)) as sp:
+                # Phase 1: inter-broker replica movement (throttled).
+                if plan.inter_broker_tasks and not stopped:
+                    with self._lock:
+                        self._state = ExecutorState.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
+                    involved = sorted({b for t in plan.inter_broker_tasks
+                                       for b in t.brokers_involved()})
+                    throttle.set_throttles(plan.inter_broker_tasks, partition_names)
+                    try:
+                        with self._phase_probe("inter_broker",
+                                               len(plan.inter_broker_tasks)):
+                            polls, stopped = self._run_inter_broker_phase(
+                                tm, partition_names, max_polls, poll_interval_s,
+                                concurrency_adjust_metrics)
+                    finally:
+                        throttle.clear_throttles(plan.inter_broker_tasks,
+                                                 partition_names)
 
-            # Phase 2: intra-broker (logdir) movement.
-            if plan.intra_broker_tasks and not stopped and not self._stop_requested:
-                with self._lock:
-                    self._state = ExecutorState.INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
-                self._run_intra_broker_phase(tm, partition_names)
+                # Phase 2: intra-broker (logdir) movement.
+                if plan.intra_broker_tasks and not stopped and not self._stop_requested:
+                    with self._lock:
+                        self._state = ExecutorState.INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
+                    with self._phase_probe("intra_broker",
+                                           len(plan.intra_broker_tasks)):
+                        self._run_intra_broker_phase(tm, partition_names)
 
-            # Phase 3: leadership movement (batched preferred elections).
-            if plan.leadership_tasks and not stopped and not self._stop_requested:
-                with self._lock:
-                    self._state = ExecutorState.LEADER_MOVEMENT_TASK_IN_PROGRESS
-                self._run_leadership_phase(tm, partition_names, max_polls,
-                                           poll_interval_s)
+                # Phase 3: leadership movement (batched preferred elections).
+                if plan.leadership_tasks and not stopped and not self._stop_requested:
+                    with self._lock:
+                        self._state = ExecutorState.LEADER_MOVEMENT_TASK_IN_PROGRESS
+                    with self._phase_probe("leadership",
+                                           len(plan.leadership_tasks)):
+                        self._run_leadership_phase(tm, partition_names, max_polls,
+                                                   poll_interval_s)
 
-            stopped = stopped or self._stop_requested
-            buckets = tm.tasks_by_state()
-            if stopped:
-                self._sensor_stopped.inc()
-            self._sensor_completed.inc(len(buckets[TaskState.COMPLETED]))
-            self._sensor_dead.inc(len(buckets[TaskState.DEAD]))
-            return ExecutionResult(
-                completed=len(buckets[TaskState.COMPLETED]),
-                dead=len(buckets[TaskState.DEAD]),
-                aborted=len(buckets[TaskState.ABORTED]),
-                polls=polls, stopped=stopped)
+                stopped = stopped or self._stop_requested
+                buckets = tm.tasks_by_state()
+                if stopped:
+                    self._sensor_stopped.inc()
+                self._sensor_completed.inc(len(buckets[TaskState.COMPLETED]))
+                self._sensor_dead.inc(len(buckets[TaskState.DEAD]))
+                sp.annotate(completed=len(buckets[TaskState.COMPLETED]),
+                            dead=len(buckets[TaskState.DEAD]),
+                            stopped=stopped, polls=polls)
+                return ExecutionResult(
+                    completed=len(buckets[TaskState.COMPLETED]),
+                    dead=len(buckets[TaskState.DEAD]),
+                    aborted=len(buckets[TaskState.ABORTED]),
+                    polls=polls, stopped=stopped)
         finally:
             with self._lock:
                 self._state = ExecutorState.NO_TASK_IN_PROGRESS

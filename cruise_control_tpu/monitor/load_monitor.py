@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
+from cruise_control_tpu.common.tracing import TRACE
 from cruise_control_tpu.model.cpu_model import (DEFAULT_CPU_WEIGHT_OF_FOLLOWER,
                                                 follower_cpu_util_from_leader_load)
 from cruise_control_tpu.model.tensor_model import BrokerState, TensorClusterModel, build_model
@@ -134,13 +135,17 @@ class LoadMonitor:
         # total-monitored-windows, cluster-model-creation-timer).
         from cruise_control_tpu.common.sensors import SENSORS
         SENSORS.gauge("LoadMonitor.valid-windows",
-                      lambda: self.partition_aggregator.valid_windows())
+                      lambda: self.partition_aggregator.valid_windows(),
+                      help="Metric windows complete enough to model from")
         SENSORS.gauge("LoadMonitor.monitored-partitions-percentage",
-                      self.monitored_partitions_percentage)
+                      self.monitored_partitions_percentage,
+                      help="Fraction of partitions with valid metric samples")
         SENSORS.gauge("LoadMonitor.total-monitored-windows",
-                      lambda: self.partition_aggregator.num_windows)
+                      lambda: self.partition_aggregator.num_windows,
+                      help="Metric windows currently retained")
         self._model_timer = SENSORS.timer(
-            "LoadMonitor.cluster-model-creation-timer")
+            "LoadMonitor.cluster-model-creation-timer",
+            help="Wall time to build a cluster model from the aggregator")
 
     # -- lifecycle / state -------------------------------------------------
     def start_up(self, skip_loading_samples: bool = False) -> None:
@@ -199,12 +204,17 @@ class LoadMonitor:
             effective = mode
             if self._execution_mode and mode == SamplingMode.ALL:
                 effective = SamplingMode.ONGOING_EXECUTION
-        cluster = self._metadata.cluster()
-        tps = [p.tp for p in cluster.partitions]
-        samples = sampler.get_samples(cluster, tps, start_ms, end_ms, effective)
-        if effective == SamplingMode.ONGOING_EXECUTION:
-            return self._ingest_on_execution(samples)
-        return self._ingest(samples, persist=True)
+        with TRACE.span("monitor.fetch", mode=effective.name) as sp:
+            cluster = self._metadata.cluster()
+            tps = [p.tp for p in cluster.partitions]
+            samples = sampler.get_samples(cluster, tps, start_ms, end_ms,
+                                          effective)
+            if effective == SamplingMode.ONGOING_EXECUTION:
+                n = self._ingest_on_execution(samples)
+            else:
+                n = self._ingest(samples, persist=True)
+            sp.annotate(samples=n)
+            return n
 
     def _ingest_on_execution(self, samples: Samples) -> int:
         """Broker samples flow normally (aggregated AND persisted, so
@@ -306,8 +316,11 @@ class LoadMonitor:
         not a fresh ``naming()`` read — membership can change mid-operation
         and would silently misaddress every proposal."""
         req = requirements or ModelCompletenessRequirements()
-        with self._model_semaphore, self._model_timer.time():
+        with self._model_semaphore, self._model_timer.time(), \
+                TRACE.span("monitor.cluster_model") as sp:
             cluster = self._metadata.cluster()
+            sp.annotate(brokers=len(cluster.brokers),
+                        partitions=cluster.partition_count())
             if self.partition_aggregator.valid_windows() < req.min_required_num_windows:
                 raise NotEnoughValidWindowsError(
                     f"have {self.partition_aggregator.valid_windows()} valid windows, "

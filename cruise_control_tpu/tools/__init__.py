@@ -1,0 +1,1 @@
+"""Operational command-line tools shipped with the package."""

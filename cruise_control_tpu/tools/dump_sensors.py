@@ -1,0 +1,115 @@
+"""Dump the sensor catalog as a markdown table.
+
+Usage: python -m cruise_control_tpu.tools.dump_sensors [--prometheus]
+
+Boots an in-memory stack (synthetic metadata + sampler, no network, no
+accelerator requirements beyond what the analyzer already needs), exercises
+the API endpoints so every lazily-registered sensor family exists, then
+prints the registry catalog sorted by name.  The table is what
+docs/OBSERVABILITY.md's catalog section is generated from — re-run and diff
+after adding sensors.
+
+With --prometheus, prints the full /metrics exposition instead.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def build_stack():
+    """In-memory service stack mirroring tests/test_api.py::build_stack."""
+    import numpy as np
+
+    from cruise_control_tpu.api.facade import CruiseControl
+    from cruise_control_tpu.api.server import CruiseControlApi
+    from cruise_control_tpu.detector.manager import AnomalyDetectorManager
+    from cruise_control_tpu.detector.notifier import SelfHealingNotifier
+    from cruise_control_tpu.executor.admin import InMemoryClusterAdmin
+    from cruise_control_tpu.executor.executor import Executor
+    from cruise_control_tpu.monitor.capacity import StaticCapacityResolver
+    from cruise_control_tpu.monitor.load_monitor import LoadMonitor
+    from cruise_control_tpu.monitor.metadata import (BrokerInfo, ClusterMetadata,
+                                                     MetadataClient, PartitionInfo)
+    from cruise_control_tpu.monitor.sampling import SyntheticWorkloadSampler
+
+    window_ms = 300_000
+    rng = np.random.default_rng(19)
+    num_brokers = 5
+    brokers = tuple(BrokerInfo(b, rack=f"r{b % 3}", host=f"h{b}")
+                    for b in range(num_brokers))
+    w = np.linspace(1, 4, num_brokers)
+    w /= w.sum()
+    parts = []
+    for t in range(3):
+        for p in range(8):
+            reps = tuple(int(x) for x in
+                         rng.choice(num_brokers, 2, replace=False, p=w))
+            parts.append(PartitionInfo(f"t{t}", p, leader=reps[0], replicas=reps))
+    mc = MetadataClient(ClusterMetadata(brokers=brokers, partitions=tuple(parts)))
+    lm = LoadMonitor(mc, StaticCapacityResolver(), num_partition_windows=3,
+                     partition_window_ms=window_ms)
+    lm.start_up()
+    sampler = SyntheticWorkloadSampler()
+    for wdx in range(4):
+        lm.fetch_once(sampler, wdx * window_ms, wdx * window_ms + 1)
+    admin = InMemoryClusterAdmin(mc, latency_polls=1)
+    ex = Executor(admin, mc)
+    cc = CruiseControl(lm, ex, admin,
+                       goals=["RackAwareGoal", "DiskCapacityGoal",
+                              "ReplicaDistributionGoal",
+                              "LeaderReplicaDistributionGoal"],
+                       hard_goals=["RackAwareGoal", "DiskCapacityGoal"])
+    mgr = AnomalyDetectorManager(SelfHealingNotifier(), cc,
+                                 executor_busy=lambda: ex.has_ongoing_execution)
+    from cruise_control_tpu.detector.detectors import BrokerFailureDetector
+    mgr.register_detector(BrokerFailureDetector(mc), interval_ms=1)
+    return CruiseControlApi(cc, detector_manager=mgr, sampler=sampler), mgr
+
+
+def exercise(api, mgr) -> None:
+    """Hit enough endpoints that every sensor family registers.  The
+    non-dryrun rebalance drives the executor phases (in-memory admin, so it
+    completes in milliseconds); the detector tick registers the per-detector
+    duration histogram."""
+    for method, endpoint, query in [
+        ("GET", "state", {}),
+        ("GET", "load", {}),
+        ("GET", "kafka_cluster_state", {}),
+        ("POST", "rebalance", {"dryrun": "true", "max_wait_s": "300"}),
+        ("POST", "rebalance", {"dryrun": "false", "max_wait_s": "300"}),
+        ("GET", "user_tasks", {}),
+        ("GET", "trace", {}),
+        ("GET", "metrics", {}),
+    ]:
+        status, _, _ = api.handle(method, endpoint, query)
+        if status >= 400:
+            print(f"warning: {method} /{endpoint} -> {status}", file=sys.stderr)
+    mgr.run_detectors_once(now_ms=1)
+
+
+def catalog_markdown(catalog) -> str:
+    lines = ["| sensor | kind | labels | prometheus family | help |",
+             "|---|---|---|---|---|"]
+    for entry in sorted(catalog, key=lambda e: (e["name"], e["prometheus"])):
+        labels = ", ".join(entry["labels"]) if entry["labels"] else "—"
+        lines.append(f"| `{entry['name']}` | {entry['kind']} | {labels} "
+                     f"| `{entry['prometheus']}` | {entry['help'] or '—'} |")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    from cruise_control_tpu.common.sensors import SENSORS
+
+    api, mgr = build_stack()
+    exercise(api, mgr)
+    if "--prometheus" in argv:
+        print(SENSORS.prometheus_text(), end="")
+    else:
+        print(catalog_markdown(SENSORS.catalog()))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

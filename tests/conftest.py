@@ -36,6 +36,21 @@ import pytest  # noqa: E402
 
 
 @pytest.fixture(autouse=True, scope="module")
+def _reset_observability_between_modules():
+    """Fresh sensor registry and trace ring per test module.
+
+    Both are process-global singletons; gauge callbacks are keep-first, so
+    without a reset the first module's LoadMonitor/Executor instances would
+    pin every gauge for the rest of the pytest process and later modules'
+    value assertions would read stale objects."""
+    from cruise_control_tpu.common.sensors import SENSORS
+    from cruise_control_tpu.common.tracing import TRACE
+    SENSORS.reset()
+    TRACE.reset()
+    yield
+
+
+@pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():
     """Free compiled executables between test modules.
 

@@ -1,0 +1,308 @@
+"""Observability surface tests: Prometheus exposition lint, span traces,
+request metering, and the registry's collision/keep-first semantics.
+
+The exposition lint is deliberately a grammar check against the Prometheus
+text-format 0.0.4 spec, not string snapshots — any sensor anyone adds later
+is linted for free.
+"""
+
+import math
+import re
+
+from cruise_control_tpu.analyzer import optimizer as opt
+from cruise_control_tpu.common.sensors import SENSORS, MetricRegistry
+from cruise_control_tpu.common.tracing import TRACE, Tracer
+from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster
+
+from tests.test_api import build_stack
+
+# ---- Prometheus text-format 0.0.4 grammar -----------------------------------
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_HELP_RE = re.compile(rf"^# HELP ({_NAME}) (.*)$")
+_TYPE_RE = re.compile(rf"^# TYPE ({_NAME}) (counter|gauge|summary|histogram)$")
+_LABELS = r'\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"' \
+          r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\}'
+_SAMPLE_RE = re.compile(
+    rf"^({_NAME})({_LABELS})? "
+    r"(NaN|[+-]Inf|[+-]?[0-9]+(\.[0-9]+)?([eE][+-]?[0-9]+)?)$")
+_LE_RE = re.compile(r'le="([^"]*)"')
+
+
+def _lint(text):
+    """Parse an exposition; assert the grammar; return
+    {family: {"type": kind, "samples": [(name, labels_str, value)]}}."""
+    families = {}
+    helped, typed = set(), set()
+    for line in text.splitlines():
+        if not line:
+            continue
+        m = _HELP_RE.match(line)
+        if m:
+            assert m.group(1) not in helped, f"duplicate HELP {m.group(1)}"
+            helped.add(m.group(1))
+            continue
+        m = _TYPE_RE.match(line)
+        if m:
+            assert m.group(1) not in typed, f"duplicate TYPE {m.group(1)}"
+            typed.add(m.group(1))
+            families[m.group(1)] = {"type": m.group(2), "samples": []}
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"line fails text-format grammar: {line!r}"
+        name = m.group(1)
+        base = next((f for f in (name, name.rsplit("_bucket", 1)[0],
+                                 name.rsplit("_sum", 1)[0],
+                                 name.rsplit("_count", 1)[0])
+                     if f in families), None)
+        assert base is not None, f"sample {name!r} has no TYPE header"
+        families[base]["samples"].append(
+            (name, m.group(2) or "", m.group(3)))
+    assert helped == typed == set(families), \
+        "every family needs exactly one HELP and one TYPE"
+    return families
+
+
+def _strip_le(labels):
+    """Label string minus the ``le`` pair — the series key shared by a
+    histogram's _bucket/_sum/_count samples."""
+    pairs = [p for p in re.findall(r'[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"',
+                                   labels or "")
+             if not p.startswith('le="')]
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+def _histogram_checks(base, samples):
+    """Cumulative-bucket invariants for one histogram family."""
+    by_series = {}
+    for name, labels, value in samples:
+        if name == base + "_bucket":
+            key = _strip_le(labels)
+            le = _LE_RE.search(labels).group(1)
+            by_series.setdefault(key, {}).setdefault("buckets", []).append(
+                (math.inf if le == "+Inf" else float(le), int(value)))
+        elif name == base + "_count":
+            by_series.setdefault(labels, {})["count"] = int(value)
+        elif name == base + "_sum":
+            by_series.setdefault(labels, {})["sum"] = float(value)
+    for key, s in by_series.items():
+        assert {"buckets", "count", "sum"} <= set(s), (base, key, s)
+        bounds = [b for b, _ in s["buckets"]]
+        counts = [c for _, c in s["buckets"]]
+        assert bounds == sorted(bounds) and bounds[-1] == math.inf
+        assert counts == sorted(counts), f"{base}{key}: non-cumulative buckets"
+        assert counts[-1] == s["count"], \
+            f"{base}{key}: +Inf bucket {counts[-1]} != _count {s['count']}"
+
+
+def test_prometheus_exposition_lints_clean():
+    api, _, _ = build_stack()
+    for method, endpoint, query in [("GET", "state", {}), ("GET", "load", {}),
+                                    ("POST", "rebalance",
+                                     {"dryrun": "true", "max_wait_s": "300"})]:
+        status, _, _ = api.handle(method, endpoint, query)
+        assert status == 200
+    status, body, headers = api.handle("GET", "metrics",
+                                       {"format": "prometheus"})
+    assert status == 200
+    assert headers == {}
+    text = str(body)
+    families = _lint(text)
+    assert families, "exposition is empty"
+    for base, fam in families.items():
+        assert fam["samples"], f"{base} has TYPE but no samples"
+        if fam["type"] == "histogram":
+            _histogram_checks(base, fam["samples"])
+    # Spot-check the families the instrumentation promises.
+    req = families["kafka_cruisecontrol_webserver_request_duration_seconds"]
+    assert req["type"] == "histogram"
+    endpoints_seen = {m.group(1) for _, labels, _ in req["samples"]
+                      for m in [re.search(r'endpoint="([^"]*)"', labels)] if m}
+    assert {"state", "load", "rebalance"} <= endpoints_seen
+    codes = families["kafka_cruisecontrol_webserver_responses_total"]
+    assert codes["type"] == "counter"
+    assert any('code="200"' in labels for _, labels, _ in codes["samples"])
+    assert "kafka_cruisecontrol_LoadMonitor_valid_windows" in families
+
+
+def test_request_metering_counts_errors_too():
+    api, _, _ = build_stack()
+    status, _, _ = api.handle("POST", "rebalance", {"dryrun": "bogus"})
+    assert status == 400
+    snap = SENSORS.snapshot()
+    assert snap['webserver.responses-total{code="400",endpoint="rebalance"}'] >= 1
+
+
+def test_rebalance_trace_round_trip():
+    api, _, _ = build_stack()
+    status, body, headers = api.handle(
+        "POST", "rebalance", {"dryrun": "true", "max_wait_s": "300"})
+    assert status == 200
+    task_id = headers["User-Task-ID"]
+
+    status, body, _ = api.handle("GET", "trace", {"task_id": task_id})
+    assert status == 200
+    assert body["userTaskId"] == task_id
+    root = body["trace"]
+    assert root["name"] == "request.rebalance"
+    assert root["attrs"]["task_id"] == task_id
+
+    def find(span, name):
+        out = [span] if span["name"] == name else []
+        for c in span.get("children", []):
+            out.extend(find(c, name))
+        return out
+
+    # monitor → per-goal → proposal span chain under the facade op.
+    (facade,) = find(root, "facade.rebalance")
+    assert facade["attrs"]["dryrun"] is True
+    assert find(facade, "monitor.cluster_model")
+    (optimize,) = find(facade, "analyzer.optimize")
+    goals = find(optimize, "analyzer.goal")
+    assert {g["attrs"]["goal"] for g in goals} == \
+        {"RackAwareGoal", "DiskCapacityGoal", "ReplicaDistributionGoal",
+         "LeaderReplicaDistributionGoal"}
+    for g in goals:
+        assert g["attrs"]["steps"] >= 0 and g["attrs"]["actions"] >= 0
+    (proposals,) = find(facade, "analyzer.proposals")
+    assert proposals["attrs"]["proposals"] == facade["attrs"]["proposals"]
+
+    # The same trace is also reachable by trace_id and in the recent list.
+    status, again, _ = api.handle("GET", "trace",
+                                  {"trace_id": root["traceId"]})
+    assert status == 200 and again["trace"]["traceId"] == root["traceId"]
+    status, listing, _ = api.handle("GET", "trace", {})
+    assert status == 200
+    assert any(t["traceId"] == root["traceId"] for t in listing["traces"])
+    assert "request.rebalance" in listing["rollup"]
+
+
+def test_execution_trace_includes_executor_phases():
+    api, _, _ = build_stack()
+    status, body, headers = api.handle(
+        "POST", "rebalance", {"dryrun": "false", "max_wait_s": "300"})
+    assert status == 200 and body["ok"] and body["execution"]["completed"] > 0
+    _, body, _ = api.handle("GET", "trace",
+                            {"task_id": headers["User-Task-ID"]})
+    root = body["trace"]
+
+    def find(span, name):
+        out = [span] if span["name"] == name else []
+        for c in span.get("children", []):
+            out.extend(find(c, name))
+        return out
+
+    (execute,) = find(root, "executor.execute")
+    assert execute["attrs"]["completed"] > 0
+    assert not execute["attrs"]["stopped"]
+    phases = {c["name"] for c in execute["children"]}
+    assert "executor.inter_broker" in phases or \
+        "executor.leadership" in phases
+    snap = SENSORS.snapshot()
+    assert any(k.startswith("Executor.phase-duration-seconds{") and
+               v["count"] >= 1 for k, v in snap.items()
+               if isinstance(v, dict))
+
+
+def test_trace_unknown_ids_404():
+    api, _, _ = build_stack()
+    assert api.handle("GET", "trace", {"task_id": "nope"})[0] == 404
+    assert api.handle("GET", "trace", {"trace_id": "t999999"})[0] == 404
+
+
+def test_goal_spans_match_optimizer_run():
+    model = generate_cluster(ClusterSpec(num_brokers=5, num_racks=5, seed=11))
+    TRACE.reset()
+    run = opt.optimize(model, ["ReplicaDistributionGoal", "RackAwareGoal"],
+                       raise_on_hard_failure=False)
+    (root,) = TRACE.recent(1)
+    assert root["name"] == "analyzer.optimize"
+    goals = {c["attrs"]["goal"]: c for c in root["children"]
+             if c["name"] == "analyzer.goal"}
+    assert set(goals) == {g.name for g in run.goal_results}
+    for g in run.goal_results:
+        attrs = goals[g.name]["attrs"]
+        assert attrs["steps"] == g.steps
+        assert attrs["actions"] == g.actions_applied
+        assert attrs["satisfied_after"] == g.satisfied_after
+        assert attrs["fresh_compile"] == g.fresh_compile
+        assert goals[g.name]["durationMs"] == round(g.duration_s * 1000.0, 3)
+    # Second run re-uses the compiled fixpoint: fresh_compile flips off.
+    run2 = opt.optimize(model, ["ReplicaDistributionGoal", "RackAwareGoal"],
+                        raise_on_hard_failure=False)
+    assert all(not g.fresh_compile for g in run2.goal_results)
+
+
+def test_state_sensors_include_trace_rollup():
+    api, _, _ = build_stack()
+    assert api.handle("POST", "rebalance",
+                      {"dryrun": "true", "max_wait_s": "300"})[0] == 200
+    _, body, _ = api.handle("GET", "state", {})
+    sensors = body["Sensors"]
+    assert "request.rebalance" in sensors["traces"]
+    assert sensors["traces"]["request.rebalance"]["count"] >= 1
+
+
+# ---- registry unit semantics ------------------------------------------------
+
+def test_gauge_keeps_first_callback():
+    reg = MetricRegistry()
+    reg.gauge("g", fn=lambda: 1.0)
+    g = reg.gauge("g", fn=lambda: 2.0)  # duplicate: logged and ignored
+    assert g.value == 1.0
+    # A set-style gauge upgrades to a callback exactly once.
+    reg.gauge("h").set(5.0)
+    assert reg.gauge("h", fn=lambda: 7.0).value == 7.0
+    assert reg.gauge("h", fn=lambda: 9.0).value == 7.0
+
+
+def test_mangled_name_collision_gets_suffix():
+    reg = MetricRegistry()
+    reg.counter("a.b", help="first").inc(1)
+    reg.counter("a-b", help="second").inc(2)
+    reg.counter("a_b", help="third").inc(3)
+    text = reg.prometheus_text(prefix="p")
+    families = _lint(text)
+    assert set(families) == {"p_a_b", "p_a_b_2", "p_a_b_3"}
+    values = {name: fam["samples"][0][2] for name, fam in families.items()}
+    assert values == {"p_a_b": "1", "p_a_b_2": "2", "p_a_b_3": "3"}
+    # Same family re-registered keeps its one exposition name.
+    reg.counter("a.b").inc(10)
+    assert _lint(reg.prometheus_text(prefix="p"))["p_a_b"]["samples"][0][2] == "11"
+
+
+def test_histogram_bucket_ladder_is_per_family():
+    reg = MetricRegistry()
+    reg.histogram("d", buckets=[0.1, 1.0],
+                  labels={"phase": "a"}).observe(0.05)
+    h2 = reg.histogram("d", buckets=[99.0],  # ignored: family ladder is fixed
+                       labels={"phase": "b"})
+    assert h2.buckets == (0.1, 1.0)
+    h2.observe(50.0)  # lands in +Inf only
+    snap = h2.snapshot()
+    assert snap["buckets"]["1"] == 0 and snap["buckets"]["+Inf"] == 1
+
+
+def test_tracer_ring_is_bounded_and_rollup_aggregates():
+    tr = Tracer(ring=4)
+    for i in range(6):
+        with tr.span("op", i=i):
+            pass
+    recent = tr.recent(10)
+    assert len(recent) == 4
+    assert recent[0]["attrs"]["i"] == 5  # newest first
+    assert tr.get(recent[-1]["traceId"]) is not None
+    assert tr.rollup()["op"]["count"] == 4
+    # Evicted roots are also dropped from the by-id index.
+    assert tr.get("t000001") is None
+
+
+def test_span_error_annotation_and_orphan_recovery():
+    tr = Tracer()
+    try:
+        with tr.span("boom"):
+            raise ValueError("x")
+    except ValueError:
+        pass
+    (t,) = tr.recent(1)
+    assert t["attrs"]["error"] == "ValueError"
+    assert tr.current() is None

@@ -3,7 +3,10 @@
 Usage: BENCH_SCALE=small python tools/profile_latency.py
 Runs the fused path with per-goal chunking so per-goal step counts are
 real, and prints steps/actions per goal to find where the serial-iteration
-floor is.
+floor is.  Timings are read back from the span tracer
+(cruise_control_tpu.common.tracing) — the same ``analyzer.optimize`` /
+``analyzer.goal`` spans the /trace endpoint serves — rather than from
+ad-hoc bookkeeping, so this doubles as a smoke test of the tracer.
 """
 import os
 import sys
@@ -18,6 +21,7 @@ def main():
     scale = os.environ.get("BENCH_SCALE", "small")
     brokers, racks, topics, ppt, rf = SCALES[scale]
     from cruise_control_tpu.analyzer import optimizer as opt
+    from cruise_control_tpu.common.tracing import TRACE
     from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster
 
     spec = ClusterSpec(num_brokers=brokers, num_racks=racks, num_topics=topics,
@@ -34,17 +38,31 @@ def main():
                  fuse_group_size=1)
     print(f"compile+run: {time.monotonic()-t0:.2f}s", flush=True)
 
+    TRACE.reset()
     t0 = time.monotonic()
-    run = opt.optimize(model, STACK, raise_on_hard_failure=False, fused=True,
-                       fuse_group_size=1)
+    opt.optimize(model, STACK, raise_on_hard_failure=False, fused=True,
+                 fuse_group_size=1)
     wall = time.monotonic() - t0
+
+    # Called outside any request, optimize() roots its own trace:
+    # analyzer.optimize -> analyzer.goal children carrying steps/actions.
+    traces = TRACE.recent(1)
+    if not traces or traces[0]["name"] != "analyzer.optimize":
+        print("ERROR: no analyzer.optimize trace recorded", file=sys.stderr)
+        sys.exit(1)
+    root = traces[0]
     tot_steps = 0
-    for g in run.goal_results:
-        tot_steps += g.steps
-        print(f"{g.name:44s} steps={g.steps:4d} actions={g.actions_applied:5d} "
-              f"dur={g.duration_s*1000:8.1f}ms sat={g.satisfied_after} capped={g.capped}")
-    print(f"TOTAL wall={wall:.3f}s steps={tot_steps} "
-          f"per-step={wall/max(tot_steps,1)*1000:.1f}ms")
+    for span in root.get("children", []):
+        if span["name"] != "analyzer.goal":
+            continue
+        a = span.get("attrs", {})
+        tot_steps += a.get("steps", 0)
+        print(f"{a.get('goal', '?'):44s} steps={a.get('steps', 0):4d} "
+              f"actions={a.get('actions', a.get('actions_applied', 0)):5d} "
+              f"dur={span['durationMs']:8.1f}ms sat={a.get('satisfied_after')} "
+              f"capped={a.get('capped')} fresh_compile={a.get('fresh_compile')}")
+    print(f"TOTAL wall={wall:.3f}s span={root['durationMs']:.1f}ms "
+          f"steps={tot_steps} per-step={wall/max(tot_steps,1)*1000:.1f}ms")
 
 
 if __name__ == "__main__":
