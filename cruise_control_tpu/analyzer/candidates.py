@@ -66,13 +66,30 @@ def _recv_ok(arrays: BrokerArrays, options: OptimizationOptions) -> Array:
     return ok & (~any_requested | options.requested_dest_only)
 
 
-def move_candidates(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
-                    constraint: BalancingConstraint, options: OptimizationOptions,
-                    num_sources: int, num_dests: int) -> Candidates:
-    """K = S·D inter-broker replica-move candidates for this goal."""
-    relevance = kernels.source_replica_relevance(spec, model, arrays, constraint)
+def _finish_move_legs(model: TensorClusterModel, arrays: BrokerArrays,
+                      options: OptimizationOptions, replica: Array, dest: Array,
+                      ok: Array) -> Candidates:
+    """One legitimacy mask + ONE make_candidates over concatenated move legs.
+    The per-builder versions each paid their own _legit_move_mask (~128 ops)
+    and make_candidates (~177 ops); a step combining cross + matched batches
+    pays them once over the concatenation instead."""
+    k = replica.shape[0]
+    action_type = jnp.full((k,), ActionType.INTER_BROKER_REPLICA_MOVEMENT, jnp.int32)
+    dest_replica = jnp.full((k,), -1, jnp.int32)
+    valid = ok & _legit_move_mask(model, arrays, options, replica, dest)
+    return make_candidates(model, replica, dest, action_type, dest_replica, valid)
+
+
+def _cross_move_legs(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
+                     constraint: BalancingConstraint, options: OptimizationOptions,
+                     num_sources: int, num_dests: int,
+                     relevance=None, bands=None):
+    """(replica, dest, ok), each [S·D] — the top-S × top-D cross legs."""
+    if relevance is None:
+        relevance = kernels.source_replica_relevance(spec, model, arrays,
+                                                     constraint, bands=bands)
     rel_vals, src_replicas = jax.lax.top_k(relevance, num_sources)  # [S]
-    room = kernels.dest_room(spec, model, arrays, constraint)
+    room = kernels.dest_room(spec, model, arrays, constraint, bands=bands)
     # Destinations must be able to receive replicas at all.
     room = jnp.where(_recv_ok(arrays, options), room, -jnp.inf)
     _, dest_brokers = jax.lax.top_k(room, num_dests)  # [D]
@@ -80,18 +97,106 @@ def move_candidates(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArr
     replica = jnp.repeat(src_replicas, num_dests)          # [K]
     dest = jnp.tile(dest_brokers, num_sources)             # [K]
     src_ok = jnp.repeat(rel_vals > _NEG, num_dests)
+    return replica, dest, src_ok
 
-    k = replica.shape[0]
-    action_type = jnp.full((k,), ActionType.INTER_BROKER_REPLICA_MOVEMENT, jnp.int32)
-    dest_replica = jnp.full((k,), -1, jnp.int32)
 
-    valid = src_ok & _legit_move_mask(model, arrays, options, replica, dest)
-    return make_candidates(model, replica, dest, action_type, dest_replica, valid)
+def move_candidates(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
+                    constraint: BalancingConstraint, options: OptimizationOptions,
+                    num_sources: int, num_dests: int,
+                    relevance=None, bands=None) -> Candidates:
+    """K = S·D inter-broker replica-move candidates for this goal."""
+    replica, dest, src_ok = _cross_move_legs(
+        spec, model, arrays, constraint, options, num_sources, num_dests,
+        relevance=relevance, bands=bands)
+    return _finish_move_legs(model, arrays, options, replica, dest, src_ok)
+
+
+def _matched_move_legs(spec: GoalSpec, model: TensorClusterModel,
+                       arrays: BrokerArrays, constraint: BalancingConstraint,
+                       options: OptimizationOptions, num_out: int,
+                       relevance=None, bands=None):
+    """(replica, dest, ok), each [2·num_out] — the transport-matched legs
+    (see matched_move_candidates for the semantics)."""
+    B = model.num_brokers
+    R = model.num_replicas_padded
+    num_out = max(1, min(num_out, R))
+    metric = kernels.broker_metric(spec, model, arrays, constraint)  # f32[B]
+    lower, upper = bands if bands is not None else \
+        kernels.limits(spec, model, arrays, constraint)
+    # Shed target: down to the upper band normally; down to the band
+    # midpoint while some broker sits below the lower band (the pull phase,
+    # rebalanceByMovingLoadIn, ResourceDistributionGoal.java:446-535 —
+    # in-band brokers above the midpoint donate too).  One threshold covers
+    # both phases without double-counting an over-band broker's surplus.
+    under_exists = (arrays.alive & (metric < lower)).any()
+    shed_to = jnp.where(under_exists, (lower + upper) * 0.5, upper)
+    src_n = jnp.ceil(jnp.maximum(metric - shed_to, 0.0)).astype(jnp.int32)
+    recv_ok = _recv_ok(arrays, options)
+    room_n = jnp.where(recv_ok,
+                       jnp.floor(jnp.maximum(upper - metric, 0.0)), 0.0
+                       ).astype(jnp.int32)
+    # A shedding broker must not soak up its own surplus: its leftover room
+    # under the upper band would claim transport slots whose self-moves the
+    # legitimacy mask then discards, wasting matched throughput exactly at
+    # the band edges the match exists for.
+    room_n = jnp.where(src_n > 0, 0, room_n)
+
+    # Rank each replica within its broker (stable sort by broker; invalid
+    # replicas sort last) so exactly the first over_n[b] replicas of broker
+    # b become sources.
+    rb = model.replica_broker
+    key = jnp.where(model.replica_valid, rb, B)
+    order = jnp.argsort(key, stable=True)
+    sorted_key = key[order]
+    # First sorted position of each present broker id via scatter-min (the
+    # equivalent searchsorted lowers to ~21 ops; only present ids are ever
+    # gathered below, where the two agree).
+    start = jnp.full((B + 1,), R, jnp.int32).at[jnp.minimum(sorted_key, B)].min(
+        jnp.arange(R, dtype=jnp.int32))
+    rank_sorted = jnp.arange(R, dtype=jnp.int32) - \
+        start[jnp.minimum(sorted_key, B)]
+    rank = jnp.zeros((R,), jnp.int32).at[order].set(rank_sorted)
+    is_src = model.replica_valid & (rank < src_n[rb])
+
+    # Prioritize sources by the goal's own relevance ranking, then take the
+    # top num_out (static shape).
+    if relevance is None:
+        relevance = kernels.source_replica_relevance(spec, model, arrays,
+                                                     constraint, bands=bands)
+    rel = jnp.where(is_src, relevance, -jnp.inf)
+    rel_vals, src_replicas = jax.lax.top_k(rel, num_out)           # [K]
+    src_ok = jnp.isfinite(rel_vals)
+
+    # Transport match: slot i lands on the broker covering position i of the
+    # room prefix sum (biggest receivers first, so heavy room drains first).
+    room_vals, room_order = jax.lax.top_k(room_n, B)               # desc [B]
+    cum = jnp.cumsum(room_vals)
+    slot = jnp.arange(num_out, dtype=cum.dtype)
+    # pos[i] = #{cum <= i}: a histogram of the (ascending) prefix sums plus
+    # one cumsum replaces searchsorted(cum, slot, "right").
+    counts = jnp.zeros((num_out + 1,), jnp.int32).at[
+        jnp.minimum(cum, num_out)].add(1)
+    pos = jnp.cumsum(counts)[:num_out]
+    dest1 = room_order[jnp.minimum(pos, B - 1)]                    # [K]
+    dest_ok = slot < cum[B - 1]
+    # Second leg: the next broker in room order.  A source whose matched
+    # destination already hosts a sibling would otherwise retry the same
+    # collision next step (the match is deterministic in the model state) —
+    # the selection's partition pass keeps at most one leg per replica, so
+    # this costs no throughput.
+    dest2 = room_order[jnp.minimum(pos + 1, B - 1)]
+
+    replica = jnp.concatenate([src_replicas, src_replicas])
+    dest = jnp.concatenate([dest1, dest2])
+    ok = jnp.concatenate([src_ok & dest_ok,
+                          src_ok & dest_ok & (dest2 != dest1)])
+    return replica, dest, ok
 
 
 def matched_move_candidates(spec: GoalSpec, model: TensorClusterModel,
                             arrays: BrokerArrays, constraint: BalancingConstraint,
-                            options: OptimizationOptions, num_out: int) -> Candidates:
+                            options: OptimizationOptions, num_out: int,
+                            relevance=None, bands=None) -> Candidates:
     """K = num_out 1:1 MATCHED move candidates for the replica-count
     distribution goal: the surplus replicas of over-band brokers are
     assigned to under-band brokers' remaining room by a prefix-sum
@@ -112,84 +217,18 @@ def matched_move_candidates(spec: GoalSpec, model: TensorClusterModel,
     equivalent, with the band budgets in select_batched still enforcing
     exactness.
     """
-    B = model.num_brokers
-    R = model.num_replicas_padded
-    num_out = max(1, min(num_out, R))
-    metric = kernels.broker_metric(spec, model, arrays, constraint)  # f32[B]
-    lower, upper = kernels.limits(spec, model, arrays, constraint)
-    # Shed target: down to the upper band normally; down to the band
-    # midpoint while some broker sits below the lower band (the pull phase,
-    # rebalanceByMovingLoadIn, ResourceDistributionGoal.java:446-535 —
-    # in-band brokers above the midpoint donate too).  One threshold covers
-    # both phases without double-counting an over-band broker's surplus.
-    under_exists = (arrays.alive & (metric < lower)).any()
-    shed_to = jnp.where(under_exists, (lower + upper) * 0.5, upper)
-    src_n = jnp.ceil(jnp.maximum(metric - shed_to, 0.0)).astype(jnp.int32)
-    recv_ok = _recv_ok(arrays, options)
-    room_n = jnp.where(recv_ok,
-                       jnp.floor(jnp.maximum(upper - metric, 0.0)), 0.0
-                       ).astype(jnp.int32)
-
-    # Rank each replica within its broker (stable sort by broker; invalid
-    # replicas sort last) so exactly the first over_n[b] replicas of broker
-    # b become sources.
-    rb = model.replica_broker
-    key = jnp.where(model.replica_valid, rb, B)
-    order = jnp.argsort(key, stable=True)
-    sorted_key = key[order]
-    start = jnp.searchsorted(sorted_key, jnp.arange(B + 1, dtype=sorted_key.dtype),
-                             side="left")
-    rank_sorted = jnp.arange(R, dtype=jnp.int32) - \
-        start[jnp.minimum(sorted_key, B)].astype(jnp.int32)
-    rank = jnp.zeros((R,), jnp.int32).at[order].set(rank_sorted)
-    is_src = model.replica_valid & (rank < src_n[rb])
-
-    # Prioritize sources by the goal's own relevance ranking, then take the
-    # top num_out (static shape).
-    relevance = kernels.source_replica_relevance(spec, model, arrays, constraint)
-    rel = jnp.where(is_src, relevance, -jnp.inf)
-    rel_vals, src_replicas = jax.lax.top_k(rel, num_out)           # [K]
-    src_ok = jnp.isfinite(rel_vals)
-
-    # Transport match: slot i lands on the broker covering position i of the
-    # room prefix sum (biggest receivers first, so heavy room drains first).
-    room_vals, room_order = jax.lax.top_k(room_n, B)               # desc [B]
-    cum = jnp.cumsum(room_vals)
-    slot = jnp.arange(num_out, dtype=cum.dtype)
-    pos = jnp.searchsorted(cum, slot, side="right")
-    dest1 = room_order[jnp.minimum(pos, B - 1)]                    # [K]
-    dest_ok = slot < cum[B - 1]
-    # Second leg: the next broker in room order.  A source whose matched
-    # destination already hosts a sibling would otherwise retry the same
-    # collision next step (the match is deterministic in the model state) —
-    # the selection's partition pass keeps at most one leg per replica, so
-    # this costs no throughput.
-    dest2 = room_order[jnp.minimum(pos + 1, B - 1)]
-
-    replica = jnp.concatenate([src_replicas, src_replicas])
-    dest = jnp.concatenate([dest1, dest2])
-    src_ok2 = jnp.concatenate([src_ok & dest_ok,
-                               src_ok & dest_ok & (dest2 != dest1)])
-    k = replica.shape[0]
-    action_type = jnp.full((k,), ActionType.INTER_BROKER_REPLICA_MOVEMENT,
-                           jnp.int32)
-    dest_replica = jnp.full((k,), -1, jnp.int32)
-    valid = src_ok2 & _legit_move_mask(model, arrays, options, replica, dest)
-    return make_candidates(model, replica, dest, action_type,
-                           dest_replica, valid)
+    replica, dest, ok = _matched_move_legs(
+        spec, model, arrays, constraint, options, num_out,
+        relevance=relevance, bands=bands)
+    return _finish_move_legs(model, arrays, options, replica, dest, ok)
 
 
-def matched_topic_candidates(spec: GoalSpec, model: TensorClusterModel,
-                             arrays: BrokerArrays, constraint: BalancingConstraint,
-                             options: OptimizationOptions, num_out: int) -> Candidates:
-    """K = 2·num_out matched move candidates for TopicReplicaDistribution:
-    the per-(topic, broker) overages are matched onto the same topic's
-    under-band pairs by a per-topic prefix-sum transport (the topic-major
-    flattening keeps every topic's slots contiguous, so one global cumsum +
-    searchsorted assigns all topics at once).  Same rationale as
-    matched_move_candidates — the goal's S×D cross batch drains a hot pair
-    at lane speed; here each surplus replica is its own candidate.
-    Reference loop: TopicReplicaDistributionGoal.rebalanceForBroker."""
+def _matched_topic_legs(spec: GoalSpec, model: TensorClusterModel,
+                        arrays: BrokerArrays, constraint: BalancingConstraint,
+                        options: OptimizationOptions, num_out: int,
+                        relevance=None):
+    """(replica, dest, ok), each [2·num_out] — the per-topic transport legs
+    (see matched_topic_candidates for the semantics)."""
     B = model.num_brokers
     T = model.num_topics
     R = model.num_replicas_padded
@@ -229,13 +268,18 @@ def matched_topic_candidates(spec: GoalSpec, model: TensorClusterModel,
     key = jnp.where(model.replica_valid, pair, T * B)
     order = jnp.argsort(key, stable=True)
     sorted_key = key[order]
-    start = jnp.searchsorted(sorted_key, jnp.arange(T * B + 1, dtype=sorted_key.dtype))
+    # Scatter-min first-position table (present keys only are gathered;
+    # cheaper than the equivalent searchsorted — see _matched_move_legs).
+    start = jnp.full((T * B + 1,), R, jnp.int32).at[
+        jnp.minimum(sorted_key, T * B)].min(jnp.arange(R, dtype=jnp.int32))
     rank_sorted = jnp.arange(R, dtype=jnp.int32) - \
-        start[jnp.minimum(sorted_key, T * B)].astype(jnp.int32)
+        start[jnp.minimum(sorted_key, T * B)]
     rank = jnp.zeros((R,), jnp.int32).at[order].set(rank_sorted)
     is_src = model.replica_valid & (rank < src_n.reshape(-1)[jnp.minimum(pair, T * B - 1)])
 
-    relevance = kernels.source_replica_relevance(spec, model, arrays, constraint)
+    if relevance is None:
+        relevance = kernels.source_replica_relevance(spec, model, arrays,
+                                                     constraint)
     rel = jnp.where(is_src, relevance, -jnp.inf)
     rel_vals, src_replicas = jax.lax.top_k(rel, num_out)           # [S]
     src_ok = jnp.isfinite(rel_vals)
@@ -246,9 +290,10 @@ def matched_topic_candidates(spec: GoalSpec, model: TensorClusterModel,
     t_key = jnp.where(src_ok, t_src, T)
     s_order = jnp.argsort(t_key, stable=True)
     s_sorted_t = t_key[s_order]
-    t_start = jnp.searchsorted(s_sorted_t, jnp.arange(T + 1, dtype=s_sorted_t.dtype))
+    t_start = jnp.full((T + 1,), num_out, jnp.int32).at[
+        jnp.minimum(s_sorted_t, T)].min(jnp.arange(num_out, dtype=jnp.int32))
     p_sorted = jnp.arange(num_out, dtype=jnp.int32) - \
-        t_start[jnp.minimum(s_sorted_t, T)].astype(jnp.int32)
+        t_start[jnp.minimum(s_sorted_t, T)]
     p_in_topic = jnp.zeros((num_out,), jnp.int32).at[s_order].set(p_sorted)
 
     # Topic-major slot table [T, 2B]: each topic's deficit slots (largest
@@ -275,13 +320,57 @@ def matched_topic_candidates(spec: GoalSpec, model: TensorClusterModel,
     replica = jnp.concatenate([src_replicas, src_replicas])
     dest = jnp.concatenate([dest1, dest2])
     ok = jnp.concatenate([dest_ok, dest_ok & (dest2 != dest1)])
-    k = replica.shape[0]
-    action_type = jnp.full((k,), ActionType.INTER_BROKER_REPLICA_MOVEMENT,
-                           jnp.int32)
-    dest_replica = jnp.full((k,), -1, jnp.int32)
-    valid = ok & _legit_move_mask(model, arrays, options, replica, dest)
-    return make_candidates(model, replica, dest, action_type,
-                           dest_replica, valid)
+    return replica, dest, ok
+
+
+def matched_topic_candidates(spec: GoalSpec, model: TensorClusterModel,
+                             arrays: BrokerArrays, constraint: BalancingConstraint,
+                             options: OptimizationOptions, num_out: int,
+                             relevance=None) -> Candidates:
+    """K = 2·num_out matched move candidates for TopicReplicaDistribution:
+    the per-(topic, broker) overages are matched onto the same topic's
+    under-band pairs by a per-topic prefix-sum transport (the topic-major
+    flattening keeps every topic's slots contiguous, so one global cumsum +
+    searchsorted assigns all topics at once).  Same rationale as
+    matched_move_candidates — the goal's S×D cross batch drains a hot pair
+    at lane speed; here each surplus replica is its own candidate.
+    Reference loop: TopicReplicaDistributionGoal.rebalanceForBroker."""
+    replica, dest, ok = _matched_topic_legs(
+        spec, model, arrays, constraint, options, num_out, relevance=relevance)
+    return _finish_move_legs(model, arrays, options, replica, dest, ok)
+
+
+def combined_move_candidates(spec: GoalSpec, model: TensorClusterModel,
+                             arrays: BrokerArrays, constraint: BalancingConstraint,
+                             options: OptimizationOptions, cross_sources: int,
+                             num_dests: int, num_matched: int = 0,
+                             relevance=None, bands=None) -> Candidates:
+    """ONE move batch combining the cross legs with the goal's matched legs
+    (replica- or topic-distribution transport match, when ``num_matched`` >
+    0).  Building them as one batch shares the relevance ranking, the
+    legitimacy mask and make_candidates' delta math across all legs — the
+    separate-builders path paid each of those twice per step."""
+    if relevance is None:
+        relevance = kernels.source_replica_relevance(spec, model, arrays,
+                                                     constraint, bands=bands)
+    replica, dest, ok = _cross_move_legs(
+        spec, model, arrays, constraint, options, cross_sources, num_dests,
+        relevance=relevance, bands=bands)
+    if num_matched > 0 and spec.kind == "replica_distribution":
+        r2, d2, ok2 = _matched_move_legs(
+            spec, model, arrays, constraint, options, num_matched,
+            relevance=relevance, bands=bands)
+    elif num_matched > 0 and spec.kind == "topic_replica_distribution":
+        r2, d2, ok2 = _matched_topic_legs(
+            spec, model, arrays, constraint, options, num_matched,
+            relevance=relevance)
+    else:
+        r2 = None
+    if r2 is not None:
+        replica = jnp.concatenate([replica, r2])
+        dest = jnp.concatenate([dest, d2])
+        ok = jnp.concatenate([ok, ok2])
+    return _finish_move_legs(model, arrays, options, replica, dest, ok)
 
 
 def default_num_matched(model: TensorClusterModel, num_sources: int) -> int:
@@ -325,11 +414,13 @@ def _legit_move_mask(model: TensorClusterModel, arrays: BrokerArrays,
 
 def leadership_candidates(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
                           constraint: BalancingConstraint, options: OptimizationOptions,
-                          num_sources: int) -> Candidates:
+                          num_sources: int, relevance=None, bands=None) -> Candidates:
     """K = S·max_rf leadership-transfer candidates: each top-ranked leader
     replica paired with each follower sibling of its partition
     (relocateLeadership semantics, ClusterModel.java:406)."""
-    relevance = kernels.source_replica_relevance(spec, model, arrays, constraint)
+    if relevance is None:
+        relevance = kernels.source_replica_relevance(spec, model, arrays,
+                                                     constraint, bands=bands)
     relevance = jnp.where(model.replica_is_leader, relevance, -jnp.inf)
     rel_vals, src_replicas = jax.lax.top_k(relevance, num_sources)  # [S]
 
@@ -369,12 +460,14 @@ def leadership_candidates(spec: GoalSpec, model: TensorClusterModel, arrays: Bro
 
 def intra_disk_candidates(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
                           constraint: BalancingConstraint, options: OptimizationOptions,
-                          num_sources: int) -> Candidates:
+                          num_sources: int, relevance=None, bands=None) -> Candidates:
     """K = S·max_disks_per_broker intra-broker disk-move candidates: each
     top-ranked replica paired with every disk of its own broker
     (IntraBrokerDiskUsageDistributionGoal's balanceBetweenDisks,
     goals/IntraBrokerDiskUsageDistributionGoal.java:47)."""
-    relevance = kernels.source_replica_relevance(spec, model, arrays, constraint)
+    if relevance is None:
+        relevance = kernels.source_replica_relevance(spec, model, arrays,
+                                                     constraint, bands=bands)
     rel_vals, src_replicas = jax.lax.top_k(relevance, num_sources)  # [S]
 
     broker = model.replica_broker[src_replicas]
@@ -408,7 +501,8 @@ def default_num_swap_partners(model: TensorClusterModel) -> int:
 
 def swap_candidates(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
                     constraint: BalancingConstraint, options: OptimizationOptions,
-                    num_out: int, num_in: int) -> Candidates:
+                    num_out: int, num_in: int,
+                    relevance=None, bands=None) -> Candidates:
     """K = S_out·S_in inter-broker replica-SWAP candidates.
 
     The reference's pairwise swap search walks an over-utilized broker's
@@ -419,13 +513,15 @@ def swap_candidates(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArr
     in-replicas (low-metric brokers, small size, so the net transfer sheds
     load from the over side) and all pairs are masked/scored at once.
     """
-    relevance = kernels.source_replica_relevance(spec, model, arrays, constraint)
+    if relevance is None:
+        relevance = kernels.source_replica_relevance(spec, model, arrays,
+                                                     constraint, bands=bands)
     _, out_replicas = jax.lax.top_k(relevance, num_out)            # [S1]
     out_vals = relevance[out_replicas]
 
     # Swap-in ranking: replicas on brokers with the most headroom under the
     # goal metric, smaller first (maximizes the net shed of a pair).
-    room = kernels.dest_room(spec, model, arrays, constraint)
+    room = kernels.dest_room(spec, model, arrays, constraint, bands=bands)
     recv_ok = arrays.alive & ~options.broker_excluded_replica_move
     room = jnp.where(recv_ok, room, -jnp.inf)
     metric_res = spec.resource if spec.resource >= 0 else 3
@@ -486,11 +582,13 @@ def _legit_swap_mask(model: TensorClusterModel, arrays: BrokerArrays,
 def intra_swap_candidates(spec: GoalSpec, model: TensorClusterModel,
                           arrays: BrokerArrays, constraint: BalancingConstraint,
                           options: OptimizationOptions, num_out: int,
-                          num_in: int) -> Candidates:
+                          num_in: int, relevance=None, bands=None) -> Candidates:
     """K = S_out·S_in intra-broker disk-SWAP candidates: replicas of the same
     broker on different disks exchange places (INTRA_BROKER_REPLICA_SWAP;
     the reference's intra-broker swap variant, AbstractGoal.java:345-424)."""
-    relevance = kernels.source_replica_relevance(spec, model, arrays, constraint)
+    if relevance is None:
+        relevance = kernels.source_replica_relevance(spec, model, arrays,
+                                                     constraint, bands=bands)
     _, out_replicas = jax.lax.top_k(relevance, num_out)
     out_vals = relevance[out_replicas]
 

@@ -350,9 +350,13 @@ def _src_unhealthy(model: TensorClusterModel, cand: Candidates, arrays: BrokerAr
 
 
 def self_feasible(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
-                  cand: Candidates, constraint: BalancingConstraint) -> Array:
+                  cand: Candidates, constraint: BalancingConstraint,
+                  bands=None) -> Array:
     """bool[K] — candidate is a legal self-improvement for this goal
-    (selfSatisfied + per-goal move eligibility)."""
+    (selfSatisfied + per-goal move eligibility).  ``bands`` optionally
+    supplies this goal's precomputed (lower, upper) limits — the band sides
+    are step-invariant, so the fixpoint hoists them out of the loop body
+    (optimizer.compute_step_invariants)."""
     kind = spec.kind
     unhealthy = _src_unhealthy(model, cand, arrays)
     if kind == "preferred_leader":
@@ -385,7 +389,8 @@ def self_feasible(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArray
         stays = (c_dest + 1 <= up) & ((c_src - 1 >= lo) | unhealthy)
         return cand.is_move() & helps & stays
     metric = broker_metric(spec, model, arrays, constraint)
-    lower, upper = limits(spec, model, arrays, constraint)
+    lower, upper = bands if bands is not None else \
+        limits(spec, model, arrays, constraint)
     d_src, d_dest = _candidate_deltas(spec, cand)
     src_m, dest_m = metric[cand.src], metric[cand.dest]
     src_after, dest_after = src_m + d_src, dest_m + d_dest
@@ -541,10 +546,12 @@ def accepts(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
 
 
 def score(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
-          cand: Candidates, constraint: BalancingConstraint) -> Array:
+          cand: Candidates, constraint: BalancingConstraint,
+          bands=None) -> Array:
     """f32[K] — improvement of the goal objective (higher is better; > 0
     required to apply).  Healing moves get a dominating bonus so offline
-    replicas drain first (GoalUtils.ensureNoOfflineReplicas semantics)."""
+    replicas drain first (GoalUtils.ensureNoOfflineReplicas semantics).
+    ``bands`` optionally supplies the precomputed (lower, upper) limits."""
     kind = spec.kind
     unhealthy = _src_unhealthy(model, cand, arrays)
     bonus = jnp.where(unhealthy & cand.is_move(), _OFFLINE_BONUS, 0.0)
@@ -604,7 +611,8 @@ def score(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
         after = (c_src - 1 - avg_t) ** 2 + (c_dest + 1 - avg_t) ** 2
         return (before - after) + bonus
     metric = broker_metric(spec, model, arrays, constraint)
-    lower, upper = limits(spec, model, arrays, constraint)
+    lower, upper = bands if bands is not None else \
+        limits(spec, model, arrays, constraint)
     d_src, d_dest = _candidate_deltas(spec, cand)
     src_m, dest_m = metric[cand.src], metric[cand.dest]
     if kind in ("capacity", "potential_nw_out", "replica_capacity"):
@@ -628,11 +636,12 @@ def score(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
 # ---------------------------------------------------------------------------
 
 def source_pressure(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
-                    constraint: BalancingConstraint) -> Array:
+                    constraint: BalancingConstraint, bands=None) -> Array:
     """f32[B] — how urgently each broker needs to shed (goal metric above
     upper limit; dead brokers get a dominating value)."""
     metric = broker_metric(spec, model, arrays, constraint)
-    lower, upper = limits(spec, model, arrays, constraint)
+    lower, upper = bands if bands is not None else \
+        limits(spec, model, arrays, constraint)
     over = jnp.maximum(metric - upper, 0.0)
     scale = jnp.maximum(jnp.abs(upper), 1.0)
     pressure = over / scale
@@ -652,14 +661,15 @@ def source_pressure(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArr
 
 
 def dest_room(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
-              constraint: BalancingConstraint) -> Array:
+              constraint: BalancingConstraint, bands=None) -> Array:
     """f32[B] — headroom under the goal's upper limit (candidate dests)."""
     if spec.kind == "min_topic_leaders":
         # Destinations are exactly the brokers short of designated leaders.
         shortfall = _min_topic_leader_shortfall(model, arrays, constraint)
         return jnp.where(arrays.alive, shortfall, -_BIG)
     metric = broker_metric(spec, model, arrays, constraint)
-    lower, upper = limits(spec, model, arrays, constraint)
+    lower, upper = bands if bands is not None else \
+        limits(spec, model, arrays, constraint)
     room = jnp.minimum(upper, _BIG) - metric
     # Prefer brokers below the lower limit (they *need* load).
     room = room + jnp.maximum(lower - metric, 0.0) * 10.0
@@ -667,11 +677,13 @@ def dest_room(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
 
 
 def source_replica_relevance(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
-                             constraint: BalancingConstraint) -> Array:
+                             constraint: BalancingConstraint, bands=None) -> Array:
     """f32[R] — ranking for choosing which replicas to propose moving.
     Combines source-broker pressure with a per-replica tiebreak (bigger
     replicas first, mirroring the reference's load-sorted candidate replicas
-    via SortedReplicas, model/SortedReplicas.java:47)."""
+    via SortedReplicas, model/SortedReplicas.java:47).  One evaluation is
+    ~150 small ops — the step graph computes it ONCE and shares it across
+    every candidate batch of the step (``bands`` as in source_pressure)."""
     kind = spec.kind
     if kind == "preferred_leader":
         wrong = _wrong_leader_mask(model)
@@ -706,7 +718,8 @@ def source_replica_relevance(spec: GoalSpec, model: TensorClusterModel, arrays: 
         base = jnp.where(dead, _BIG,
                          jnp.where(over | donor, 1.0 + 1e-3 * size / scale, -_BIG))
         return jnp.where(model.replica_valid & on_disk, base, -_BIG)
-    pressure = source_pressure(spec, model, arrays, constraint)[model.replica_broker]
+    pressure = source_pressure(spec, model, arrays, constraint,
+                               bands=bands)[model.replica_broker]
     if kind in ("rack", "rack_distribution"):
         conflict = _replica_rack_conflict(spec, model)
         base = jnp.where(conflict, 1.0, -_BIG)

@@ -34,6 +34,8 @@ optimized set grows by one after each goal.
 from __future__ import annotations
 
 import dataclasses
+import logging
+import os
 import time
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -48,10 +50,14 @@ from cruise_control_tpu.analyzer.actions import Candidates, apply_candidates
 from cruise_control_tpu.analyzer.balancing_constraint import BalancingConstraint
 from cruise_control_tpu.analyzer.goals import kernels
 from cruise_control_tpu.analyzer.goals.specs import GoalSpec, goals_by_priority
-from cruise_control_tpu.analyzer.state import BrokerArrays, OptimizationOptions
+from cruise_control_tpu.analyzer.state import (BrokerArrays, OptimizationOptions,
+                                               StepInvariants)
+from cruise_control_tpu.common import compile_cache
 from cruise_control_tpu.common.tracing import TRACE
 from cruise_control_tpu.model.stats import ClusterModelStats, compute_stats_jit
 from cruise_control_tpu.model.tensor_model import TensorClusterModel
+
+_LOG = logging.getLogger(__name__)
 
 _MIN_SCORE = 1e-9  # strictly-positive improvement required (greedy accept)
 
@@ -99,13 +105,24 @@ def _prefix_admit_role(score: Array, seg: Array, deltas: Array, kept: Array,
     s_seg = seg[order]
     s_deltas = jnp.where(kept[order][:, None], deltas[order], 0.0)
     cs = jnp.cumsum(s_deltas, axis=0)                       # [K, C]
-    seg_start = jnp.searchsorted(s_seg, jnp.arange(num_segments,
-                                                   dtype=s_seg.dtype))
+    # First sorted position per present segment (scatter-min; the equivalent
+    # searchsorted lowers to ~21 ops — absent segments get K, never read).
+    seg_start = jnp.full((num_segments,), K, jnp.int32).at[s_seg].min(
+        jnp.arange(K, dtype=jnp.int32))
     base = jnp.where((seg_start > 0)[:, None],
                      cs[jnp.maximum(seg_start - 1, 0)], 0.0)  # [B, C]
     prefix = cum_before[s_seg] + cs - base[s_seg]           # incl. self
-    eps = 1e-6
-    ok = ((prefix <= hi[s_seg] + eps) & (prefix >= lo[s_seg] - eps)).all(axis=1)
+    hi_s = hi[s_seg]
+    lo_s = lo[s_seg]
+    # RELATIVE tolerance: the bounds span bytes-scale channels (1e9+) where
+    # an absolute 1e-6 is far below float32 resolution and count channels
+    # near 0 where it is the right size — scale by the bound magnitude,
+    # floored at 1 so the absolute behavior survives for counts.
+    scale = jnp.maximum(1.0, jnp.maximum(
+        jnp.where(jnp.isfinite(hi_s), jnp.abs(hi_s), 0.0),
+        jnp.where(jnp.isfinite(lo_s), jnp.abs(lo_s), 0.0)))
+    eps = 1e-6 * scale
+    ok = ((prefix <= hi_s + eps) & (prefix >= lo_s - eps)).all(axis=1)
     # A candidate is admitted only if itself and every better-scored
     # candidate of its segment fit (monotone prefix).
     bad = jnp.cumsum((~ok).astype(jnp.int32))
@@ -177,17 +194,15 @@ def _channel_deltas(cand: Candidates):
     return d_src, d_dest
 
 
-def _channel_budgets(specs: Tuple[GoalSpec, ...], model: TensorClusterModel,
-                     arrays: BrokerArrays, constraint: BalancingConstraint):
-    """(room_dest f32[B, 8], slack_src f32[B, 8]) — how much each broker may
-    cumulatively gain / shed per channel this step without violating ANY
-    band goal in ``specs`` (the current goal + every previously optimized
-    one).  This is what makes multi-accept exact: per-candidate acceptance
-    checks hold against the pre-step state, and these budgets bound the
-    *sum* of accepted deltas per broker so the post-step state still
-    respects every band."""
+def _band_sides(specs: Tuple[GoalSpec, ...], model: TensorClusterModel,
+                arrays: BrokerArrays, constraint: BalancingConstraint):
+    """(upper_min f32[B, 8], lower_max f32[B, 8]) — the folded band SIDES of
+    every band goal in ``specs``.  Step-invariant: static capacities ×
+    thresholds, plus averages over alive-broker totals that replica moves /
+    swaps / leadership transfers between alive brokers conserve — so the
+    fixpoint computes this once (compute_step_invariants) and only the
+    metrics side of the budgets is rebuilt per step."""
     B = model.num_brokers
-    metrics = _channel_metrics(model, arrays)
     upper_min = jnp.full((B, NUM_CHANNELS), jnp.inf, jnp.float32)
     lower_max = jnp.full((B, NUM_CHANNELS), -jnp.inf, jnp.float32)
     # The resource-axis kinds are computed VECTORIZED over all four
@@ -207,6 +222,13 @@ def _channel_budgets(specs: Tuple[GoalSpec, ...], model: TensorClusterModel,
                       jnp.concatenate([upper_cap, pad], axis=1), jnp.inf))
     dist_channels = [s.resource for s in specs
                      if s.kind == "resource_distribution"]
+    # accepts_band_batch treats HARD-configured distribution goals as
+    # cap-style (upper bound only, both brokers) — the lower side must not
+    # fold into the budgets either, or the budgets would enforce a lower
+    # band the acceptance oracle never checks.
+    soft_dist_channels = [s.resource for s in specs
+                          if s.kind == "resource_distribution"
+                          and not s.is_hard]
     if dist_channels:
         bp = jnp.asarray([constraint.balance_percentage(r) for r in range(4)],
                          jnp.float32)
@@ -221,9 +243,6 @@ def _channel_budgets(specs: Tuple[GoalSpec, ...], model: TensorClusterModel,
         # (the _BIG sentinel under low-utilization gating included).
         up_d = jnp.where(gated[None, :], kernels._BIG,
                          avg_pct[None, :] * bp[None, :] * arrays.capacity)
-        lo_d = jnp.where(gated[None, :], 0.0,
-                         jnp.maximum(avg_pct[None, :] * (2.0 - bp)[None, :]
-                                     * arrays.capacity, 0.0))
         sel = np.zeros((NUM_CHANNELS,), bool)
         sel[np.asarray(dist_channels)] = True
         pad = jnp.full((B, 4), jnp.inf)
@@ -231,18 +250,80 @@ def _channel_budgets(specs: Tuple[GoalSpec, ...], model: TensorClusterModel,
             upper_min, jnp.where(jnp.asarray(sel)[None, :],
                                  jnp.concatenate([up_d, pad], axis=1),
                                  jnp.inf))
+    if soft_dist_channels:
+        lo_d = jnp.where(gated[None, :], 0.0,
+                         jnp.maximum(avg_pct[None, :] * (2.0 - bp)[None, :]
+                                     * arrays.capacity, 0.0))
+        sel = np.zeros((NUM_CHANNELS,), bool)
+        sel[np.asarray(soft_dist_channels)] = True
         lower_max = jnp.maximum(
             lower_max, jnp.where(jnp.asarray(sel)[None, :],
                                  jnp.concatenate([lo_d, -pad], axis=1),
                                  -jnp.inf))
-    for spec in specs:
-        ch = _spec_channel(spec)
-        if ch is None or spec.kind in ("capacity", "resource_distribution"):
-            continue
-        lo, up = kernels.limits(spec, model, arrays, constraint)
-        upper_min = upper_min.at[:, ch].min(up)
-        if spec.kind not in _CAP_ONLY_KINDS:
+    # The remaining channel kinds, vectorized the same way: ONE masked-sum
+    # pass produces the count/bytes averages every count-style band is
+    # built from (mirroring kernels.limits' per-kind branches exactly).
+    rem = [s for s in specs
+           if s.kind not in ("capacity", "resource_distribution")
+           and _spec_channel(s) is not None]
+    if rem:
+        kinds = {s.kind for s in rem}
+        if {"replica_distribution", "leader_replica_distribution"} & kinds:
+            cnt2 = jnp.where(arrays.alive[:, None],
+                             jnp.stack([arrays.replica_count,
+                                        arrays.leader_count], axis=1), 0)
+            avg_cnt = cnt2.sum(axis=0) / arrays.num_alive           # f32[2]
+        ups, los = [], []
+        if "replica_capacity" in kinds:
+            ups.append((4, jnp.full(
+                (B,), float(constraint.max_replicas_per_broker), jnp.float32)))
+        if "potential_nw_out" in kinds:
+            nw_out = kernels.Resource.NW_OUT
+            ups.append((6, arrays.capacity[:, nw_out]
+                        * constraint.capacity_threshold[nw_out]))
+        if "replica_distribution" in kinds:
+            bp_r = kernels._margin_pct(constraint.replica_count_balance_threshold)
+            ups.append((4, jnp.broadcast_to(jnp.ceil(avg_cnt[0] * bp_r), (B,))))
+            if any(s.kind == "replica_distribution" and not s.is_hard
+                   for s in rem):
+                los.append((4, jnp.broadcast_to(
+                    jnp.floor(avg_cnt[0] * (2.0 - bp_r)), (B,))))
+        if "leader_replica_distribution" in kinds:
+            bp_l = kernels._margin_pct(
+                constraint.leader_replica_count_balance_threshold)
+            ups.append((5, jnp.broadcast_to(jnp.ceil(avg_cnt[1] * bp_l), (B,))))
+            if any(s.kind == "leader_replica_distribution" and not s.is_hard
+                   for s in rem):
+                los.append((5, jnp.broadcast_to(
+                    jnp.floor(avg_cnt[1] * (2.0 - bp_l)), (B,))))
+        if "leader_bytes_in" in kinds:
+            nw_in = kernels.Resource.NW_IN
+            bp_b = kernels._margin_pct(constraint.resource_balance_threshold[nw_in])
+            avg_b = jnp.where(arrays.alive, arrays.leader_bytes_in, 0.0).sum() \
+                / arrays.num_alive
+            ups.append((7, jnp.broadcast_to(avg_b * bp_b, (B,))))
+        for ch, up in ups:
+            upper_min = upper_min.at[:, ch].min(up)
+        for ch, lo in los:
             lower_max = lower_max.at[:, ch].max(lo)
+    return upper_min, lower_max
+
+
+def _channel_budgets(specs: Tuple[GoalSpec, ...], model: TensorClusterModel,
+                     arrays: BrokerArrays, constraint: BalancingConstraint,
+                     sides=None):
+    """(room_dest f32[B, 8], slack_src f32[B, 8]) — how much each broker may
+    cumulatively gain / shed per channel this step without violating ANY
+    band goal in ``specs`` (the current goal + every previously optimized
+    one).  This is what makes multi-accept exact: per-candidate acceptance
+    checks hold against the pre-step state, and these budgets bound the
+    *sum* of accepted deltas per broker so the post-step state still
+    respects every band.  ``sides`` optionally supplies the precomputed
+    (upper_min, lower_max) band sides; only the current metrics and the
+    room/slack application are per-step work."""
+    metrics = _channel_metrics(model, arrays)
+    upper_min, lower_max = sides if sides is not None else \
+        _band_sides(specs, model, arrays, constraint)
     room_dest = jnp.maximum(upper_min - metrics, 0.0)
     slack_src = jnp.maximum(metrics - lower_max, 0.0)
     # Dead/invalid brokers: unlimited shed (healing drains them regardless of
@@ -299,19 +380,23 @@ def select_batched(score: Array, cand: Candidates, eligible: Array,
     # of batch width.  A tiny multiplicative hash-jitter (≤1e-4 relative)
     # spreads near-tied winners across destinations without reordering
     # meaningfully different scores.
-    idx_k = jnp.arange(score.shape[0], dtype=jnp.uint32)
-    jitter = ((idx_k * jnp.uint32(2654435761)) >> 12).astype(jnp.float32) / \
-        jnp.float32(1 << 20)
-    score = score * (1.0 + 1e-4 * jitter)
+    # The hash bits depend only on the (static) batch width — numpy math
+    # folds them into jaxpr literals (zero equations in the loop body)
+    # instead of an 8-op uint32 chain retraced into every step.
+    idx_k = np.arange(score.shape[0], dtype=np.uint32)
+    jitter = ((idx_k * np.uint32(2654435761)) >> np.uint32(12)).astype(
+        np.float32) / np.float32(1 << 20)
+    score = score * jnp.asarray(1.0 + 1e-4 * jitter)
     # Subround lane per candidate (decorrelated from the jitter bits).
-    lane = (((idx_k * jnp.uint32(0x9E3779B9)) >> 4) %
-            jnp.uint32(subrounds)).astype(jnp.int32)
+    lane_np = (((idx_k * np.uint32(0x9E3779B9)) >> np.uint32(4)) %
+               np.uint32(subrounds)).astype(np.int32)
+    lane = jnp.asarray(lane_np)
     src_lane = cand.src * subrounds + lane
     dest_lane = cand.dest * subrounds + lane
-    keep_total = jnp.zeros_like(eligible)
-    used_part = jnp.zeros((num_partitions,), bool)
-    cum_src = jnp.zeros((num_brokers, NUM_CHANNELS), jnp.float32)
-    cum_dest = jnp.zeros((num_brokers, NUM_CHANNELS), jnp.float32)
+    # Cross-round accumulators materialize lazily: round 1 knows they are
+    # all-zero (specialized below), and a single-round step — the default
+    # config — never allocates them at all.
+    keep_total = used_part = cum_src = cum_dest = None
     d_src, d_dest = _channel_deltas(cand)
     topic_on = topic_budgets is not None
     if topic_on:
@@ -354,42 +439,61 @@ def select_batched(score: Array, cand: Candidates, eligible: Array,
                                moves_tb.astype(jnp.float32)])
             d_lead = jnp.stack([-lead1, lead1])
         num_legs = leg_keys.shape[0]
-        cum_rep = jnp.zeros((n_tb,), jnp.float32)
-        cum_lead = jnp.zeros((n_tb,), jnp.float32)
+        cum_rep = cum_lead = None
         eps_tb = 1e-6
 
         def tb_ok(cum, d, gain, shed):
-            total = cum[leg_keys] + d
+            total = d if cum is None else cum[leg_keys] + d
             return ((total <= gain[leg_keys] + eps_tb) &
                     (total >= -shed[leg_keys] - eps_tb)).all(axis=0)
     if disk_guard:
         safe_sd = jnp.maximum(cand.src_disk, 0)
         safe_dd = jnp.maximum(cand.dest_disk, 0)
-        used_sdisk = jnp.zeros((model.num_disks,), bool)
-        used_ddisk = jnp.zeros((model.num_disks,), bool)
-    for _ in range(rounds):
-        elig = eligible & ~keep_total & ~used_part[cand.partition] & \
-            ~used_part[cand.partition2]
-        # Each broker's cumulative NET delta (src-role + dest-role — a broker
-        # can shed via one action and gain via another in the same step)
-        # stays inside [-shed slack, gain room].  Swaps make d_src positive
-        # (source gains) / d_dest negative (dest sheds), so BOTH bounds apply
-        # to both roles — one-sided per-role checks let a swap push its
-        # source broker over an optimized cap undetected, and separate
-        # per-role accumulators allowed up to 2× room in one step.
-        cum_net = cum_src + cum_dest
-        budget_ok = (
-            (cum_net[cand.dest] + d_dest <= room_dest[cand.dest] + eps) &
-            (cum_net[cand.dest] + d_dest >= -slack_src[cand.dest] - eps) &
-            (cum_net[cand.src] + d_src >= -slack_src[cand.src] - eps) &
-            (cum_net[cand.src] + d_src <= room_dest[cand.src] + eps)
-        ).all(axis=1)
+        used_sdisk = used_ddisk = None
+    for r in range(rounds):
+        first, last = r == 0, r == rounds - 1
+        # Round 1 is specialized on its accumulators being all-zero: the
+        # budget checks compare raw deltas against the budgets directly —
+        # no cumulative gathers/adds, no used-partition masks.  With the
+        # default config (one round of 128 lanes) that's the WHOLE loop;
+        # multi-round steps pay the general form from round 2 on.
+        if first:
+            elig = eligible
+            cum_net = jnp.zeros((num_brokers, NUM_CHANNELS), jnp.float32)
+            budget_ok = (
+                (d_dest <= room_dest[cand.dest] + eps) &
+                (d_dest >= -slack_src[cand.dest] - eps) &
+                (d_src >= -slack_src[cand.src] - eps) &
+                (d_src <= room_dest[cand.src] + eps)
+            ).all(axis=1)
+        else:
+            elig = eligible & ~keep_total & ~used_part[cand.partition] & \
+                ~used_part[cand.partition2]
+            # Each broker's cumulative NET delta (src-role + dest-role — a
+            # broker can shed via one action and gain via another in the
+            # same step) stays inside [-shed slack, gain room].  Swaps make
+            # d_src positive (source gains) / d_dest negative (dest sheds),
+            # so BOTH bounds apply to both roles — one-sided per-role
+            # checks let a swap push its source broker over an optimized
+            # cap undetected, and separate per-role accumulators allowed
+            # up to 2× room in one step.
+            cum_net = cum_src + cum_dest
+            budget_ok = (
+                (cum_net[cand.dest] + d_dest <= room_dest[cand.dest] + eps) &
+                (cum_net[cand.dest] + d_dest >= -slack_src[cand.dest] - eps) &
+                (cum_net[cand.src] + d_src >= -slack_src[cand.src] - eps) &
+                (cum_net[cand.src] + d_src <= room_dest[cand.src] + eps)
+            ).all(axis=1)
         elig = elig & budget_ok
         if topic_on:
-            elig = elig & tb_ok(cum_rep, d_rep, gain_rep, shed_rep) & \
-                tb_ok(cum_lead, d_lead, jnp.inf * jnp.ones_like(gain_rep),
-                      shed_lead)
-        if disk_guard:
+            if first:
+                cum_rep = jnp.zeros((n_tb,), jnp.float32)
+                cum_lead = jnp.zeros((n_tb,), jnp.float32)
+            elig = elig & \
+                tb_ok(None if first else cum_rep, d_rep, gain_rep, shed_rep) & \
+                tb_ok(None if first else cum_lead, d_lead,
+                      jnp.inf * jnp.ones_like(gain_rep), shed_lead)
+        if disk_guard and not first:
             touches_disk = cand.dest_disk >= 0
             elig = elig & ~(touches_disk & (used_sdisk[safe_sd] | used_ddisk[safe_dd]))
         keep = _best_per_segment(score, src_lane, num_brokers * subrounds, elig)
@@ -526,7 +630,19 @@ def select_batched(score: Array, cand: Candidates, eligible: Array,
         keep = jax.lax.cond(net_viol(keep).any(), _broker_repair,
                             lambda k: k, keep)
 
-        keep_total = keep_total | keep
+        keep_total = keep if first else keep_total | keep
+        if last:
+            # The final round's bookkeeping has no reader — skip the
+            # scatter/add chain entirely (the default single-round config
+            # never executes it at all).
+            continue
+        if first:
+            used_part = jnp.zeros((num_partitions,), bool)
+            cum_src = jnp.zeros((num_brokers, NUM_CHANNELS), jnp.float32)
+            cum_dest = jnp.zeros((num_brokers, NUM_CHANNELS), jnp.float32)
+            if disk_guard:
+                used_sdisk = jnp.zeros((model.num_disks,), bool)
+                used_ddisk = jnp.zeros((model.num_disks,), bool)
         used_part = used_part.at[jnp.where(keep, cand.partition, 0)].max(keep)
         used_part = used_part.at[jnp.where(keep, cand.partition2, 0)].max(keep)
         km = keep[:, None]
@@ -549,11 +665,13 @@ def select_batched(score: Array, cand: Candidates, eligible: Array,
 # ---------------------------------------------------------------------------
 
 def _topic_budgets(all_specs: Tuple[GoalSpec, ...], model: TensorClusterModel,
-                   arrays: BrokerArrays, constraint: BalancingConstraint):
+                   arrays: BrokerArrays, constraint: BalancingConstraint,
+                   inv: Optional[StepInvariants] = None):
     """(gain_rep, shed_rep, shed_lead), each f32[T*B] — how much each
     (topic, broker) pair may cumulatively gain / shed in replica count and
     shed in leader count this step without leaving any optimized topic
-    band.  None when no topic-metric goal is in play."""
+    band.  None when no topic-metric goal is in play.  ``inv`` optionally
+    supplies the step-invariant topic band sides / designated mask."""
     has_topic = any(s.kind == "topic_replica_distribution" for s in all_specs)
     has_min_leaders = any(s.kind == "min_topic_leaders" for s in all_specs)
     if not has_topic and not has_min_leaders:
@@ -564,7 +682,10 @@ def _topic_budgets(all_specs: Tuple[GoalSpec, ...], model: TensorClusterModel,
     alive_row = arrays.alive[None, :]
     if has_topic:
         tbc = model.topic_broker_replica_counts().astype(jnp.float32)
-        lower_t, upper_t = kernels._topic_limits(model, arrays, constraint)
+        if inv is not None and inv.topic_lower is not None:
+            lower_t, upper_t = inv.topic_lower, inv.topic_upper
+        else:
+            lower_t, upper_t = kernels._topic_limits(model, arrays, constraint)
         gain = jnp.maximum(upper_t[:, None] - tbc, 0.0)
         shed = jnp.maximum(tbc - lower_t[:, None], 0.0)
         # Dead brokers shed without band limits (healing; mirrors the broker
@@ -573,13 +694,39 @@ def _topic_budgets(all_specs: Tuple[GoalSpec, ...], model: TensorClusterModel,
         gain_rep, shed_rep = gain.reshape(-1), shed.reshape(-1)
     if has_min_leaders:
         tlc = model.topic_leader_counts().astype(jnp.float32)
-        designated = kernels._designated_topic_mask(model, constraint)
+        if inv is not None and inv.designated is not None:
+            designated = inv.designated
+        else:
+            designated = kernels._designated_topic_mask(model, constraint)
         need = float(constraint.min_topic_leaders_per_broker)
         shed = jnp.where(designated[:, None], jnp.maximum(tlc - need, 0.0),
                          jnp.inf)
         shed = jnp.where(alive_row, shed, jnp.inf)
         shed_lead = shed.reshape(-1)
     return gain_rep, shed_rep, shed_lead
+
+
+def compute_step_invariants(spec: GoalSpec, prev_specs: Tuple[GoalSpec, ...],
+                            model: TensorClusterModel, arrays: BrokerArrays,
+                            constraint: BalancingConstraint) -> StepInvariants:
+    """All step-invariant tensors of one goal's fixpoint (see StepInvariants
+    for the invariance argument).  _goal_fixpoint computes this ONCE outside
+    its while_loop; the loop body closes over the result, so XLA hoists
+    ~20% of the former per-step op chain into the once-per-fixpoint
+    prologue."""
+    all_specs = (spec,) + tuple(prev_specs)
+    upper_min, lower_max = _band_sides(all_specs, model, arrays, constraint)
+    spec_lower, spec_upper = kernels.limits(spec, model, arrays, constraint)
+    topic_lower = topic_upper = designated = None
+    if any(s.kind == "topic_replica_distribution" for s in all_specs):
+        topic_lower, topic_upper = kernels._topic_limits(model, arrays,
+                                                         constraint)
+    if any(s.kind == "min_topic_leaders" for s in all_specs):
+        designated = kernels._designated_topic_mask(model, constraint)
+    return StepInvariants(upper_min=upper_min, lower_max=lower_max,
+                          spec_lower=spec_lower, spec_upper=spec_upper,
+                          topic_lower=topic_lower, topic_upper=topic_upper,
+                          designated=designated)
 
 
 # The tunneled TPU's remote-compile service hangs on S×D cross batches
@@ -593,6 +740,25 @@ _COMPILE_CEILING_K = 32_768
 
 
 def _cross_ceiling_k() -> Optional[int]:
+    """The active candidate-batch compile ceiling, or None when unlimited.
+
+    Gated by CRUISE_TPU_COMPILE_CEILING (env, or the
+    analyzer.tpu.compile.ceiling config key propagated to it by app.py):
+    unset / "auto" keeps the historical behavior — the ceiling binds only
+    when the tpu backend is active (the tunneled dev backend's
+    remote-compile service is what hangs on wide programs); "0" / "off" /
+    "none" disables it everywhere; a positive integer imposes that ceiling
+    on ANY backend (useful to reproduce TPU-shaped batches on CPU).
+    """
+    raw = os.environ.get("CRUISE_TPU_COMPILE_CEILING", "auto").strip().lower()
+    if raw in ("0", "off", "none", "false"):
+        return None
+    if raw not in ("", "auto"):
+        try:
+            return max(1, int(raw))
+        except ValueError:
+            _LOG.warning("ignoring non-integer CRUISE_TPU_COMPILE_CEILING=%r",
+                         raw)
     try:
         return _COMPILE_CEILING_K if jax.default_backend() == "tpu" else None
     except Exception:  # noqa: BLE001 — backend probing must never fail a run
@@ -620,17 +786,29 @@ def _goal_num_sources(spec: GoalSpec, model: TensorClusterModel,
 def _goal_step(model: TensorClusterModel, options: OptimizationOptions,
                spec: GoalSpec, prev_specs: Tuple[GoalSpec, ...],
                constraint: BalancingConstraint,
-               num_sources: int, num_dests: int, mesh=None):
+               num_sources: int, num_dests: int, mesh=None,
+               invariants: Optional[StepInvariants] = None):
     """One optimization step for ``spec``: returns (new_model, num_applied).
 
     Static args (spec, prev_specs, constraint, widths, mesh) select the
     compiled graph; model/options are traced.  With ``mesh`` set, the
     candidate batch is sharding-constrained along its K axis so GSPMD
     partitions the scoring/masking math across the mesh devices (see
-    parallel/mesh.py).
+    parallel/mesh.py).  ``invariants`` carries the step-invariant band
+    sides / topic sides precomputed by the fixpoint; a standalone step
+    computes its own (identical math, just not hoisted).
     """
     arrays = BrokerArrays.from_model(model)
     num_sources = _goal_num_sources(spec, model, num_sources, num_dests)
+    inv = invariants
+    if inv is None:
+        inv = compute_step_invariants(spec, prev_specs, model, arrays,
+                                      constraint)
+    bands = (inv.spec_lower, inv.spec_upper)
+    # ONE relevance ranking per step, shared by every candidate builder —
+    # each builder used to recompute the ~150-op ranking itself.
+    relevance = kernels.source_replica_relevance(spec, model, arrays,
+                                                 constraint, bands=bands)
 
     batches = []
     if spec.uses_moves:
@@ -641,35 +819,34 @@ def _goal_step(model: TensorClusterModel, options: OptimizationOptions,
         # carries the bulk — at the large rung the full-width cross batch
         # was pure per-step compute with its winners mostly duplicating
         # the match.
-        matched = None
+        num_matched = 0
         if spec.kind == "replica_distribution":
-            matched = cgen.matched_move_candidates(
-                spec, model, arrays, constraint, options,
-                cgen.default_num_matched(model, num_sources))
+            num_matched = cgen.default_num_matched(model, num_sources)
         elif spec.kind == "topic_replica_distribution":
             # The topic match needs the wider floor: its surplus spreads
             # over T·B pairs and narrowing the batch to the replica-goal
             # width grew the fixpoint 20 -> 27 steps at mid.
-            matched = cgen.matched_topic_candidates(
-                spec, model, arrays, constraint, options,
-                max(1, min(model.num_replicas_padded,
-                           max(16 * num_sources, 4096))))
+            num_matched = max(1, min(model.num_replicas_padded,
+                                     max(16 * num_sources, 4096)))
         # Only the replica-count goal's cross batch shrinks: the topic
         # goal's matched batch covers band entry but its cross batch still
         # finds the key-budget-constrained shuffles (shrinking it grew the
         # fixpoint 18 -> 26 steps at mid).
         cross_ns = (min(num_sources, max(64, num_sources // 4))
                     if spec.kind == "replica_distribution" else num_sources)
-        batches.append(cgen.move_candidates(spec, model, arrays, constraint,
-                                            options, cross_ns, num_dests))
-        if matched is not None:
-            batches.append(matched)
+        batches.append(cgen.combined_move_candidates(
+            spec, model, arrays, constraint, options, cross_ns, num_dests,
+            num_matched=num_matched, relevance=relevance, bands=bands))
     if spec.uses_leadership:
         batches.append(cgen.leadership_candidates(spec, model, arrays, constraint,
-                                                  options, num_sources))
+                                                  options, num_sources,
+                                                  relevance=relevance,
+                                                  bands=bands))
     if spec.uses_intra_moves:
         batches.append(cgen.intra_disk_candidates(spec, model, arrays, constraint,
-                                                  options, num_sources))
+                                                  options, num_sources,
+                                                  relevance=relevance,
+                                                  bands=bands))
     # Swap widths scale with the (possibly fast-mode / max-candidates
     # clamped) move widths so the latency/batch-size knobs bound them too.
     sw_s = min(cgen.default_num_swap_sources(model), num_sources)
@@ -677,10 +854,12 @@ def _goal_step(model: TensorClusterModel, options: OptimizationOptions,
                max(2, num_dests), model.num_replicas_padded)
     if spec.uses_swaps:
         batches.append(cgen.swap_candidates(
-            spec, model, arrays, constraint, options, sw_s, sw_p))
+            spec, model, arrays, constraint, options, sw_s, sw_p,
+            relevance=relevance, bands=bands))
     if spec.uses_intra_swaps:
         batches.append(cgen.intra_swap_candidates(
-            spec, model, arrays, constraint, options, sw_s, sw_p))
+            spec, model, arrays, constraint, options, sw_s, sw_p,
+            relevance=relevance, bands=bands))
     cand = batches[0]
     for extra in batches[1:]:
         cand = cgen.concat_candidates(cand, extra)
@@ -690,7 +869,8 @@ def _goal_step(model: TensorClusterModel, options: OptimizationOptions,
         cand = jax.tree.map(
             lambda x: jax.lax.with_sharding_constraint(x, sharding), cand)
 
-    feasible = kernels.self_feasible(spec, model, arrays, cand, constraint)
+    feasible = kernels.self_feasible(spec, model, arrays, cand, constraint,
+                                     bands=bands)
     # Band-kind prev goals' vetoes are fully subsumed by the channel
     # budgets below: room_dest/slack_src are built from the SAME
     # limits()/delta math over all_specs, and select_batched's per-candidate
@@ -709,19 +889,20 @@ def _goal_step(model: TensorClusterModel, options: OptimizationOptions,
         accepted = kernels.accepts_band_batch(prev_specs, model, arrays, cand,
                                               constraint)
     else:
-        accepted = jnp.ones(cand.k, bool)
+        accepted = None
     for prev in prev_specs:
         if not kernels.is_band_kind(prev):
-            accepted = accepted & kernels.accepts(prev, model, arrays, cand,
-                                                  constraint)
-    if _DBG_NO_ACCEPTS:
-        accepted = jnp.ones_like(accepted)
-    score = kernels.score(spec, model, arrays, cand, constraint)
+            a = kernels.accepts(prev, model, arrays, cand, constraint)
+            accepted = a if accepted is None else accepted & a
+    if accepted is None or _DBG_NO_ACCEPTS:
+        accepted = jnp.ones(cand.k, bool)
+    score = kernels.score(spec, model, arrays, cand, constraint, bands=bands)
 
     eligible = cand.valid & feasible & accepted & (score > _MIN_SCORE)
     all_specs = (spec,) + prev_specs
-    room_dest, slack_src = _channel_budgets(all_specs, model, arrays, constraint)
-    topic_budgets = _topic_budgets(all_specs, model, arrays, constraint)
+    room_dest, slack_src = _channel_budgets(all_specs, model, arrays, constraint,
+                                            sides=(inv.upper_min, inv.lower_max))
+    topic_budgets = _topic_budgets(all_specs, model, arrays, constraint, inv=inv)
     if _DBG_NO_BUDGETS:
         room_dest = jnp.full_like(room_dest, jnp.inf)
         slack_src = jnp.full_like(slack_src, jnp.inf)
@@ -752,15 +933,45 @@ def _goal_step(model: TensorClusterModel, options: OptimizationOptions,
 _step_cache: Dict[tuple, object] = {}
 
 
+def donation_copy(model: TensorClusterModel) -> TensorClusterModel:
+    """Buffer-level copy of every device leaf of ``model``.
+
+    Callers that pass ``donate_model=True`` to :func:`optimize` surrender the
+    input model's buffers (donation aliases them into the outputs and marks
+    them deleted).  A caller that still needs the pre-optimization state —
+    ``proposals.diff`` reads both sides — optimizes a copy and keeps the
+    original: ``optimize(donation_copy(model), ..., donate_model=True)``.
+    Host (numpy) leaves pass through untouched; they are never donated.
+    """
+    return jax.tree_util.tree_map(
+        lambda leaf: jnp.array(leaf) if isinstance(leaf, jax.Array) else leaf,
+        model)
+
+
+def _persist_token(kind: str, key: tuple, *trees) -> Optional[str]:
+    """Marker token for restart-aware ``fresh_compile`` reporting, or None
+    when no persistent compile cache is active (env enables lazily here so
+    ``CRUISE_COMPILE_CACHE_DIR`` works for bench/CLI runs without app.py).
+    The traced-argument shape/dtype signature joins the python cache key
+    because the jit fn re-compiles per input shape under the same key."""
+    if compile_cache.maybe_enable_from_env() is None:
+        return None
+    sig = tuple((tuple(leaf.shape), str(leaf.dtype))
+                for leaf in jax.tree_util.tree_leaves(trees)
+                if hasattr(leaf, "shape"))
+    return compile_cache.program_token(kind, key, sig)
+
+
 def _get_step_fn(spec: GoalSpec, prev_specs: Tuple[GoalSpec, ...],
                  constraint: BalancingConstraint, num_sources: int, num_dests: int,
-                 mesh=None):
-    key = (spec, prev_specs, constraint, num_sources, num_dests, mesh)
+                 mesh=None, donate: bool = False):
+    key = (spec, prev_specs, constraint, num_sources, num_dests, mesh, donate)
     fn = _step_cache.get(key)
     if fn is None:
         fn = jax.jit(partial(_goal_step, spec=spec, prev_specs=prev_specs,
                              constraint=constraint, num_sources=num_sources,
-                             num_dests=num_dests, mesh=mesh))
+                             num_dests=num_dests, mesh=mesh),
+                     donate_argnums=(0,) if donate else ())
         _step_cache[key] = fn
     return fn
 
@@ -797,6 +1008,12 @@ def _goal_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
     # goals' scoring carries the healing bonus and may act even in-band).
     any_offline = (model.replica_offline_now() & model.replica_valid).any()
     skip = before & ~any_offline
+    # Step-invariant band/topic sides, computed ONCE here: the body closes
+    # over them, so they become while_loop constvars — loop constants XLA
+    # evaluates once per fixpoint dispatch instead of once per step (see
+    # StepInvariants for why they are invariant and what freezing them at
+    # fixpoint entry means for healing runs).
+    inv = compute_step_invariants(spec, prev_specs, model, arrays0, constraint)
 
     def cond(state):
         _, steps, _, last_n = state
@@ -805,7 +1022,7 @@ def _goal_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
     def body(state):
         m, steps, total, _ = state
         new_m, n = _goal_step(m, options, spec, prev_specs, constraint,
-                              num_sources, num_dests, mesh)
+                              num_sources, num_dests, mesh, invariants=inv)
         n = n.astype(jnp.int32)
         return (new_m, steps + 1, total + n, n)
 
@@ -823,13 +1040,16 @@ _fixpoint_cache: Dict[tuple, object] = {}
 
 def _get_fixpoint_fn(spec: GoalSpec, prev_specs: Tuple[GoalSpec, ...],
                      constraint: BalancingConstraint, num_sources: int,
-                     num_dests: int, max_steps: int, mesh=None):
-    key = (spec, prev_specs, constraint, num_sources, num_dests, max_steps, mesh)
+                     num_dests: int, max_steps: int, mesh=None,
+                     donate: bool = False):
+    key = (spec, prev_specs, constraint, num_sources, num_dests, max_steps,
+           mesh, donate)
     fn = _fixpoint_cache.get(key)
     if fn is None:
         fn = jax.jit(partial(_goal_fixpoint, spec=spec, prev_specs=prev_specs,
                              constraint=constraint, num_sources=num_sources,
-                             num_dests=num_dests, max_steps=max_steps, mesh=mesh))
+                             num_dests=num_dests, max_steps=max_steps, mesh=mesh),
+                     donate_argnums=(0,) if donate else ())
         _fixpoint_cache[key] = fn
     return fn
 
@@ -874,14 +1094,16 @@ _stack_cache: Dict[tuple, object] = {}
 
 def _get_stack_fn(specs: Tuple[GoalSpec, ...], constraint: BalancingConstraint,
                   num_sources: int, num_dests: int, max_steps: int, mesh=None,
-                  prev_specs: Tuple[GoalSpec, ...] = ()):
-    key = (specs, constraint, num_sources, num_dests, max_steps, mesh, prev_specs)
+                  prev_specs: Tuple[GoalSpec, ...] = (), donate: bool = False):
+    key = (specs, constraint, num_sources, num_dests, max_steps, mesh,
+           prev_specs, donate)
     fn = _stack_cache.get(key)
     if fn is None:
         fn = jax.jit(partial(_stack_fixpoint, specs=specs, constraint=constraint,
                              num_sources=num_sources, num_dests=num_dests,
                              max_steps=max_steps, mesh=mesh,
-                             prev_specs=prev_specs))
+                             prev_specs=prev_specs),
+                     donate_argnums=(0,) if donate else ())
         _stack_cache[key] = fn
     return fn
 
@@ -961,7 +1183,7 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
              segment_steps: Optional[int] = None,
              balancedness_priority_weight: float = 1.1,
              balancedness_strictness_weight: float = 1.5,
-             mesh=None) -> OptimizerRun:
+             mesh=None, donate_model: bool = False) -> OptimizerRun:
     """Traced entry point around ``_optimize`` (see its docstring for the
     optimization semantics): the whole pass runs inside an
     ``analyzer.optimize`` span, and each goal's fixpoint stats (steps,
@@ -981,7 +1203,7 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
                         segment_steps=segment_steps,
                         balancedness_priority_weight=balancedness_priority_weight,
                         balancedness_strictness_weight=balancedness_strictness_weight,
-                        mesh=mesh)
+                        mesh=mesh, donate_model=donate_model)
         for g in run.goal_results:
             TRACE.record("analyzer.goal", g.duration_s, goal=g.name,
                          steps=g.steps, actions=g.actions_applied,
@@ -1007,7 +1229,7 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
               segment_steps: Optional[int] = None,
               balancedness_priority_weight: float = 1.1,
               balancedness_strictness_weight: float = 1.5,
-              mesh=None) -> OptimizerRun:
+              mesh=None, donate_model: bool = False) -> OptimizerRun:
     """Run the goal stack in priority order (GoalOptimizer.optimizations).
 
     Each goal optimizes the model to its fixpoint, constrained by the
@@ -1035,6 +1257,15 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
     ``shard_model_replica_axis`` and the same ``jax.sharding.Mesh`` — the
     orchestration (chunking, segmenting, acceptance context, results) is
     identical to the single-device path.
+
+    ``donate_model=True`` donates the model's device buffers into every
+    goal/stack dispatch (``jax.jit(..., donate_argnums=0)``): the chain of
+    intermediate models reuses ONE set of buffers instead of allocating a
+    fresh model per dispatch, halving peak HBM for the hot path.  The
+    CALLER'S input model is consumed by the first dispatch — pass
+    ``donation_copy(model)`` if the pre-optimization state is still needed
+    (proposals.diff reads both sides).  Ignored under ``mesh`` (sharded
+    buffers keep the conservative non-donating path).
     """
     constraint = constraint or BalancingConstraint.default()
     options = options if options is not None else OptimizationOptions.none(model)
@@ -1052,6 +1283,10 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
     # is an RPC to a tunneled TPU runtime; results stay on device, lazily
     # fetched by to_dict()).
     stats_before = compute_stats_jit(model)
+    # compute_stats_jit has already enqueued its reads of the input buffers;
+    # PJRT orders donation reuse after outstanding usages, so donating the
+    # same buffers below is safe.
+    donate = donate_model and mesh is None
     results: List[GoalResult] = []
     ns = num_sources or cgen.default_num_sources(model)
     nd = num_dests or cgen.default_num_dests(model)
@@ -1068,9 +1303,14 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
         # cross dests no longer throttle them.  Shrink nd first, then ns,
         # so the invariant ns*nd <= ceiling holds even for wide explicit
         # num_sources.
+        ns0, nd0 = ns, nd
         nd = max(8, ceiling // ns)
         if ns * nd > ceiling:
             ns = max(64, ceiling // nd)
+        _LOG.info(
+            "compile ceiling %d clamped candidate widths: num_sources "
+            "%d -> %d, num_dests %d -> %d (set CRUISE_TPU_COMPILE_CEILING="
+            "off to disable)", ceiling, ns0, ns, nd0, nd)
     scored = 0
 
     def k_of(spec: GoalSpec) -> int:
@@ -1134,9 +1374,17 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
                     seg = min(segment_steps, remaining)
                     n_cached = len(_stack_cache)
                     stack_fn = _get_stack_fn(chunk, constraint, ns, nd, seg,
-                                             mesh=mesh, prev_specs=prev)
-                    chunk_fresh |= len(_stack_cache) > n_cached
+                                             mesh=mesh, prev_specs=prev,
+                                             donate=donate)
+                    miss = len(_stack_cache) > n_cached
+                    token = _persist_token(
+                        "stack", (chunk, constraint, ns, nd, seg, mesh, prev,
+                                  donate), model, options) if miss else None
+                    chunk_fresh |= miss and not (token and
+                                                 compile_cache.seen(token))
                     model, packed = stack_fn(model, options)
+                    if token:
+                        compile_cache.mark(token)
                     row = jax.device_get(packed)[:, 0]
                     steps_t += int(row[0])
                     actions_t += int(row[1])
@@ -1154,9 +1402,20 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
                 n_cached = len(_stack_cache)
                 stack_fn = _get_stack_fn(chunk, constraint, ns, nd,
                                          max_steps_per_goal, mesh=mesh,
-                                         prev_specs=prev)
-                chunk_fresh = len(_stack_cache) > n_cached
+                                         prev_specs=prev, donate=donate)
+                miss = len(_stack_cache) > n_cached
+                # A python-dict miss alone can't tell a cold XLA build from
+                # a warm persistent-cache load after a process restart; the
+                # compile marker (written once the program exists) refines
+                # fresh_compile to "no process has built this program yet".
+                token = _persist_token(
+                    "stack", (chunk, constraint, ns, nd, max_steps_per_goal,
+                              mesh, prev, donate), model, options) if miss \
+                    else None
+                chunk_fresh = miss and not (token and compile_cache.seen(token))
                 model, packed = stack_fn(model, options)
+                if token:
+                    compile_cache.mark(token)
                 packed_rows.append(packed)
             fresh_v.extend([chunk_fresh] * len(chunk))
             prev = prev + chunk
@@ -1194,10 +1453,18 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
             t0 = time.monotonic()
             n_cached = len(_fixpoint_cache)
             fixpoint = _get_fixpoint_fn(spec, prev, constraint, ns, nd,
-                                        max_steps_per_goal, mesh=mesh)
-            fresh = len(_fixpoint_cache) > n_cached
+                                        max_steps_per_goal, mesh=mesh,
+                                        donate=donate)
+            miss = len(_fixpoint_cache) > n_cached
+            token = _persist_token(
+                "fixpoint", (spec, prev, constraint, ns, nd,
+                             max_steps_per_goal, mesh, donate),
+                model, options) if miss else None
+            fresh = miss and not (token and compile_cache.seen(token))
             model, steps_d, actions_d, before_d, after_d, capped_d = \
                 fixpoint(model, options)
+            if token:
+                compile_cache.mark(token)
             steps, actions = int(steps_d), int(actions_d)
             before, after, capped = bool(before_d), bool(after_d), bool(capped_d)
             scored += steps * k_of(spec)

@@ -10,6 +10,8 @@ and trivially correct.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax.numpy as jnp
 from flax import struct
 from jax import Array
@@ -43,6 +45,30 @@ class BrokerArrays:
             valid=model.broker_valid,
             num_alive=jnp.maximum(alive.sum(), 1),
         )
+
+
+@struct.dataclass
+class StepInvariants:
+    """Step-invariant tensors of one goal fixpoint, computed ONCE before the
+    ``lax.while_loop`` and closed over by the loop body (body constvars are
+    loop constants — XLA evaluates them once per fixpoint dispatch, not once
+    per step).  Everything here depends only on static capacities,
+    thresholds, topology, and aliveness-conserved totals: replica moves,
+    swaps, and leadership transfers between alive brokers conserve the
+    alive-broker load/count sums the band averages are built from, so the
+    band *sides* never change within a fixpoint.  (Healing moves off dead
+    brokers do shift the alive totals; the sides are frozen at fixpoint
+    entry — the final ``goal_satisfied`` check and the next goal's
+    invariants always use fresh state.)  Built by
+    ``optimizer.compute_step_invariants``."""
+
+    upper_min: Array  # f32[B, 8] — min over all optimized goals' upper sides
+    lower_max: Array  # f32[B, 8] — max over their lower sides
+    spec_lower: Array  # f32[B] — the current goal's own band
+    spec_upper: Array  # f32[B]
+    topic_lower: Optional[Array] = None  # f32[T] when a topic goal is in play
+    topic_upper: Optional[Array] = None  # f32[T]
+    designated: Optional[Array] = None  # bool[T] when min-leaders is in play
 
 
 @struct.dataclass

@@ -266,13 +266,19 @@ class CruiseControl:
         with SENSORS.timer(
                 "GoalOptimizer.proposal-computation-timer",
                 help="End-to-end goal-stack optimization wall time").time():
-            return opt.optimize(model, goal_list, constraint=self.constraint,
+            # Donate the working model's buffers through the goal-stack
+            # dispatches (intermediate models reuse one buffer set instead
+            # of piling up); the explicit copy keeps the caller's
+            # pre-optimization model alive for proposals.diff / verify_run.
+            work = opt.donation_copy(model)
+            return opt.optimize(work, goal_list, constraint=self.constraint,
                                 options=options, raise_on_hard_failure=False,
                                 fused=True, fast_mode=fast_mode,
                                 max_steps_per_goal=self._max_steps_per_goal,
                                 max_candidates_per_step=self._max_candidates_per_step,
                                 balancedness_priority_weight=self._balancedness_weights[0],
-                                balancedness_strictness_weight=self._balancedness_weights[1])
+                                balancedness_strictness_weight=self._balancedness_weights[1],
+                                donate_model=True)
 
     def _finish(self, model: TensorClusterModel, run: opt.OptimizerRun,
                 dryrun: bool, reason: str, naming: Dict[str, object],

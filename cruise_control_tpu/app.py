@@ -60,6 +60,24 @@ class KafkaCruiseControlApp:
 
     # -- assembly (KafkaCruiseControl ctor, KafkaCruiseControl.java:105-119) --
     def _build(self) -> None:
+        import os
+
+        from cruise_control_tpu.common import compile_cache
+
+        # Persistent XLA compile cache: wired before anything builds a jitted
+        # program so a restarted service pays deserialization, not a full
+        # compile, for every optimizer program it has ever built.
+        cache_dir = compile_cache.resolve_cache_dir(
+            self.config.get(C.COMPILE_CACHE_DIR_CONFIG))
+        if cache_dir is not None:
+            compile_cache.enable_persistent_cache(cache_dir)
+        # The optimizer reads the candidate-batch compile ceiling from the
+        # env (it has no config handle); propagate the config key unless the
+        # operator already pinned the env var.
+        ceiling = self.config.get(C.TPU_COMPILE_CEILING_CONFIG)
+        if ceiling and "CRUISE_TPU_COMPILE_CEILING" not in os.environ:
+            os.environ["CRUISE_TPU_COMPILE_CEILING"] = ceiling
+
         from cruise_control_tpu.api.facade import CruiseControl
         from cruise_control_tpu.api.server import (BasicSecurityProvider,
                                                    CruiseControlApi,
@@ -379,6 +397,26 @@ class KafkaCruiseControlApp:
                   for i in range(cfg.get(C.NUM_PROPOSAL_PRECOMPUTE_THREADS_CONFIG))]
         for name, fn in loops:
             t = threading.Thread(target=fn, daemon=True, name=name)
+            t.start()
+            self._threads.append(t)
+
+        # Compile warmup (compile.cache.warmup): one background proposal
+        # computation at startup builds (or, with a warm persistent compile
+        # cache, just deserializes) every goal program for the current
+        # cluster shape, so the first operator request pays no compile wait.
+        # Distinct from the precompute loop: it runs ONCE, is on even when
+        # num.proposal.precompute.threads=0, and shares its single-flight
+        # lock so they never race on the same model build.
+        if cfg.get(C.COMPILE_CACHE_WARMUP_CONFIG):
+            def warmup_once():
+                with precompute_flight:
+                    try:
+                        self.cruise_control.proposals()
+                    except Exception:  # noqa: BLE001 — not enough windows yet
+                        pass
+
+            t = threading.Thread(target=warmup_once, daemon=True,
+                                 name="cc-compile-warmup")
             t.start()
             self._threads.append(t)
 

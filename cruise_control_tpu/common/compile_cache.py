@@ -1,0 +1,154 @@
+"""Persistent XLA compilation cache wiring + restart-aware compile markers.
+
+JAX ships a content-addressed on-disk compilation cache: point
+``jax_compilation_cache_dir`` at a directory and every XLA executable is
+persisted after its first build, so a process restart pays deserialization
+(~100s of ms) instead of a full compile (~10s of seconds for the fused
+goal-stack programs).  The knob is off by default and its entry-size /
+compile-time floors would skip the small CPU programs the test suite
+builds, so this module owns the one true way to switch it on.
+
+The optimizer's ``GoalResult.fresh_compile`` flag is derived from a
+python-dict cache miss, which cannot tell a warm disk hit from a cold
+build — every goal in a restarted process would report a "fresh" compile
+that actually cost milliseconds.  Sidecar marker files (one empty file per
+program token, kept *inside* the cache dir so wiping the cache wipes the
+markers with it) record which programs some process already built; the
+optimizer reports ``fresh_compile=True`` only for programs with no marker.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+from typing import Iterable, Optional
+
+_LOG = logging.getLogger(__name__)
+
+#: Environment override for the cache directory.  Takes precedence over the
+#: ``compile.cache.dir`` config key; the sentinels below disable persistence.
+ENV_CACHE_DIR = "CRUISE_COMPILE_CACHE_DIR"
+
+_DISABLE_SENTINELS = ("off", "none", "false", "0")
+
+_enabled_dir: Optional[str] = None
+
+
+def default_cache_dir() -> str:
+    """Default location under the per-user app data dir (XDG cache dir)."""
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache")
+    return os.path.join(base, "cruise-control-tpu", "compile-cache")
+
+
+def resolve_cache_dir(configured: str = "") -> Optional[str]:
+    """Resolve the active cache dir: env override > config value > default.
+
+    Returns None (persistence disabled) when the winning value is one of
+    the disable sentinels ('off', 'none', 'false', '0').
+    """
+    raw = os.environ.get(ENV_CACHE_DIR)
+    if raw is None:
+        raw = configured or ""
+    raw = raw.strip()
+    if raw.lower() in _DISABLE_SENTINELS:
+        return None
+    return raw or default_cache_dir()
+
+
+def enable_persistent_cache(path: Optional[str] = None) -> Optional[str]:
+    """Point JAX's persistent compilation cache at ``path`` (created if
+    missing) and drop the compile-time / entry-size floors so even small
+    CPU programs persist.  Idempotent per path; returns the active dir."""
+    global _enabled_dir
+    if path is None:
+        path = default_cache_dir()
+    path = os.path.abspath(path)
+    if _enabled_dir == path:
+        return _enabled_dir
+    import jax
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_enable_compilation_cache", True)
+    try:
+        # The cache module latches "no cache" after the first compile that
+        # ran without a dir configured; enabling lazily (env-triggered from
+        # the optimizer, after backend init already compiled something)
+        # needs the latch reset or the new dir is silently ignored.
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+    except Exception as e:  # noqa: BLE001 — private API; persistence only
+        _LOG.warning("compilation cache reset unavailable (%s); persistence "
+                     "may require enabling before first compile", e)
+    _enabled_dir = path
+    _LOG.info("persistent compile cache enabled at %s", path)
+    return _enabled_dir
+
+
+def maybe_enable_from_env() -> Optional[str]:
+    """Enable the cache when ``CRUISE_COMPILE_CACHE_DIR`` is set.
+
+    Library entry points (bench, tests, notebooks) hit this lazily from the
+    optimizer; the service wires the ``compile.cache.dir`` config key
+    through app startup instead."""
+    if _enabled_dir is not None:
+        return _enabled_dir
+    raw = os.environ.get(ENV_CACHE_DIR)
+    if raw is None:
+        return None
+    raw = raw.strip()
+    if not raw or raw.lower() in _DISABLE_SENTINELS:
+        return None
+    return enable_persistent_cache(raw)
+
+
+def cache_dir() -> Optional[str]:
+    """The directory persistence is currently enabled at, or None."""
+    return _enabled_dir
+
+
+# ---------------------------------------------------------------------------
+# Compile markers (restart-aware fresh_compile)
+# ---------------------------------------------------------------------------
+
+def program_token(kind: str, key: object, arg_signature: Iterable) -> str:
+    """Stable token for one jitted program.
+
+    ``key`` is the optimizer's python-cache key (specs, constraint, widths,
+    ... — all dataclasses of primitives, so their repr is deterministic
+    across processes); ``arg_signature`` captures the traced-argument
+    shapes/dtypes the python key does not.  jax version and backend are
+    folded in because the persisted executable is specific to both.
+    """
+    import jax
+    payload = repr((kind, key, tuple(arg_signature), jax.__version__,
+                    jax.default_backend()))
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+def _marker_file(token: str) -> str:
+    assert _enabled_dir is not None
+    return os.path.join(_enabled_dir, "markers", token + ".seen")
+
+
+def seen(token: str) -> bool:
+    """True when some process already compiled (and persisted) ``token``."""
+    if _enabled_dir is None:
+        return False
+    return os.path.exists(_marker_file(token))
+
+
+def mark(token: str) -> None:
+    """Record that ``token`` has been compiled by this process."""
+    if _enabled_dir is None:
+        return
+    path = _marker_file(token)
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "a"):
+            pass
+    except OSError as e:  # marker loss only costs a pessimistic report
+        _LOG.warning("could not write compile marker %s: %s", path, e)

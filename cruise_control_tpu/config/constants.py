@@ -47,6 +47,9 @@ GOAL_BALANCEDNESS_STRICTNESS_WEIGHT_CONFIG = "goal.balancedness.strictness.weigh
 OVERPROVISIONED_MAX_REPLICAS_PER_BROKER_CONFIG = "overprovisioned.max.replicas.per.broker"
 OVERPROVISIONED_MIN_BROKERS_CONFIG = "overprovisioned.min.brokers"
 OVERPROVISIONED_MIN_EXTRA_RACKS_CONFIG = "overprovisioned.min.extra.racks"
+COMPILE_CACHE_DIR_CONFIG = "compile.cache.dir"
+COMPILE_CACHE_WARMUP_CONFIG = "compile.cache.warmup"
+TPU_COMPILE_CEILING_CONFIG = "tpu.compile.ceiling"
 
 DEFAULT_GOAL_NAMES = [
     "RackAwareGoal",
@@ -156,6 +159,20 @@ def analyzer_config_def() -> ConfigDef:
     d.define(OVERPROVISIONED_MIN_EXTRA_RACKS_CONFIG, Type.INT, 2, Range.at_least(0), Importance.LOW,
              doc="Extra racks beyond max RF any over-provisioned recommendation must keep.",
              group="analyzer")
+    d.define(COMPILE_CACHE_DIR_CONFIG, Type.STRING, "", importance=Importance.MEDIUM,
+             doc="Directory for JAX's persistent compilation cache (compiled optimizer "
+                 "programs survive process restarts).  Empty selects the default under "
+                 "the app data dir; the CRUISE_COMPILE_CACHE_DIR env var overrides; "
+                 "'off' disables persistence.", group="analyzer")
+    d.define(COMPILE_CACHE_WARMUP_CONFIG, Type.BOOLEAN, False, importance=Importance.LOW,
+             doc="Compile the default goal stack against the current cluster shape at "
+                 "startup so the first rebalance request pays no compile wait (cheap "
+                 "when the persistent compile cache is already warm).", group="analyzer")
+    d.define(TPU_COMPILE_CEILING_CONFIG, Type.STRING, "auto", importance=Importance.LOW,
+             doc="Candidate-batch compile ceiling gate (propagated to the "
+                 "CRUISE_TPU_COMPILE_CEILING env var): 'auto' caps S*D batches at "
+                 "32768 only on the tpu backend, 'off' disables the cap, an integer "
+                 "imposes that cap on any backend.", group="analyzer")
     return d
 
 

@@ -1,0 +1,79 @@
+"""Persistent compile cache: resolution rules + restart-aware fresh_compile.
+
+The cross-process test runs a tiny optimize() in two FRESH subprocesses
+sharing one cache dir: the first reports fresh_compile=True for every goal
+and seeds both the XLA disk cache and the sidecar compile markers; the
+second must report fresh_compile=False for every goal (the python-dict
+miss is refined by the marker).  Small models only — this jaxlib build is
+known to segfault serializing very large goal-stack executables (see
+tests/conftest.py), which is also why the suite's own process never
+enables the cache.
+"""
+
+import os
+import subprocess
+import sys
+
+from cruise_control_tpu.common import compile_cache
+
+
+def test_resolve_cache_dir_precedence(monkeypatch):
+    monkeypatch.delenv(compile_cache.ENV_CACHE_DIR, raising=False)
+    # Config value wins over the default; empty config selects the default.
+    assert compile_cache.resolve_cache_dir("/tmp/cfg-cache") == "/tmp/cfg-cache"
+    assert compile_cache.resolve_cache_dir("") == compile_cache.default_cache_dir()
+    # Disable sentinels, any case.
+    for s in ("off", "OFF", "none", "false", "0"):
+        assert compile_cache.resolve_cache_dir(s) is None
+    # Env overrides config, including overriding it to disabled.
+    monkeypatch.setenv(compile_cache.ENV_CACHE_DIR, "/tmp/env-cache")
+    assert compile_cache.resolve_cache_dir("/tmp/cfg-cache") == "/tmp/env-cache"
+    monkeypatch.setenv(compile_cache.ENV_CACHE_DIR, "off")
+    assert compile_cache.resolve_cache_dir("/tmp/cfg-cache") is None
+
+
+def test_program_token_is_deterministic_and_distinguishes():
+    t1 = compile_cache.program_token("stack", ("a", 1), (((4,), "f32"),))
+    t2 = compile_cache.program_token("stack", ("a", 1), (((4,), "f32"),))
+    t3 = compile_cache.program_token("stack", ("a", 2), (((4,), "f32"),))
+    t4 = compile_cache.program_token("stack", ("a", 1), (((8,), "f32"),))
+    assert t1 == t2
+    assert len({t1, t3, t4}) == 3
+
+
+_CHILD = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+from cruise_control_tpu.analyzer import optimizer as opt
+from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster
+spec = ClusterSpec(num_brokers=6, num_racks=3, num_topics=4,
+                   mean_partitions_per_topic=8.0, replication_factor=2,
+                   distribution="exponential", seed=23)
+model = jax.device_put(generate_cluster(spec))
+goals = ["RackAwareGoal", "ReplicaCapacityGoal", "ReplicaDistributionGoal"]
+run = opt.optimize(model, goals, raise_on_hard_failure=False, fused=True)
+print("FRESH=" + ",".join(str(g.fresh_compile) for g in run.goal_results))
+"""
+
+
+def _run_child(cache_dir: str) -> str:
+    env = dict(os.environ)
+    env["CRUISE_COMPILE_CACHE_DIR"] = cache_dir
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run([sys.executable, "-c", _CHILD], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = [l for l in out.stdout.splitlines() if l.startswith("FRESH=")]
+    assert line, out.stdout
+    return line[-1][len("FRESH="):]
+
+
+def test_warm_persistent_cache_across_processes(tmp_path):
+    first = _run_child(str(tmp_path))
+    assert first == "True,True,True", first
+    second = _run_child(str(tmp_path))
+    assert second == "False,False,False", second
+    # The marker sidecar AND real XLA cache entries landed in the dir.
+    assert (tmp_path / "markers").is_dir()
+    assert any(f.name.endswith("-cache") for f in tmp_path.iterdir()
+               if f.is_file())

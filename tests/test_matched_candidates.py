@@ -100,6 +100,76 @@ def test_matched_topic_moves_stay_within_topic():
     assert (tbc[t, src] > mid_t[t] - 1e-6).all()
 
 
+def test_matched_move_shedding_broker_never_receives():
+    """Band-edge regression: a broker above the shed target (pull phase:
+    the band midpoint) but still under the upper band has BOTH surplus and
+    floor-room.  Its room must be zeroed before the transport match —
+    otherwise it claims slots whose self-moves the legitimacy mask then
+    discards, wasting matched throughput exactly where the match matters.
+
+    The fixture is engineered so the transport actually REACHES the edge
+    brokers pre-fix (the match fills biggest rooms first, so the drained
+    broker's huge room must be exhausted): broker 1 is emptied (engaging
+    the pull phase; room = upper), broker 2 sits at the lower band (the
+    only other legitimate room), brokers 0 and 3..14 sit one under the
+    upper band (surplus AND room — the band edge), and the last broker
+    absorbs the remainder over-band.  Total surplus then exceeds the
+    drain+receiver room, so without the room-zeroing some transport slots
+    land on shedding brokers."""
+    model, arrays, con = build(seed=7)
+    g = goals_by_priority(["ReplicaDistributionGoal"])[0]
+    B = model.num_brokers
+    lower, upper = (np.asarray(x) for x in
+                    kernels.limits(g, model, arrays, con))
+    mid = (lower + upper) * 0.5
+    # upper-1 must clear the midpoint shed target for edge surplus > 0.
+    assert upper[0] - lower[0] > 2, "band too narrow for an edge broker"
+    rb = np.asarray(model.replica_broker)
+    rvalid = np.asarray(model.replica_valid)
+    cnt = np.bincount(rb[rvalid], minlength=B).astype(int)
+    target = np.full(B, int(np.floor(upper[0])) - 1)
+    target[1] = 0
+    target[2] = int(np.ceil(lower[2]))
+    target[B - 1] = cnt.sum() - target[: B - 1].sum()
+    assert target[B - 1] > mid[B - 1], "remainder broker must be a source"
+    surplus_t = np.ceil(np.maximum(target - mid, 0.0)).astype(int)
+    free_room = int(upper[1] - target[1]) + int(upper[2] - target[2])
+    assert surplus_t.sum() > free_room, \
+        "fixture surplus must overflow the legitimate room"
+    pool = [list(np.nonzero(rvalid & (rb == b))[0]) for b in range(B)]
+    moves, dests = [], []
+    for b in range(B):
+        moves += [pool[b].pop() for _ in range(max(cnt[b] - target[b], 0))]
+        dests += [b] * max(target[b] - cnt[b], 0)
+    assert len(moves) == len(dests)
+    model = model.relocate_replicas(
+        jnp.asarray(np.array(moves), jnp.int32),
+        jnp.asarray(np.array(dests), jnp.int32),
+        jnp.ones(len(moves), bool))
+    arrays = BrokerArrays.from_model(model)
+    options = OptimizationOptions.none(model)
+
+    metric = np.asarray(kernels.broker_metric(g, model, arrays, con))
+    lower, upper = (np.asarray(x) for x in
+                    kernels.limits(g, model, arrays, con))
+    alive = np.asarray(arrays.alive)
+    assert (alive & (metric < lower)).any(), "pull phase not engaged"
+    shed_to = (lower + upper) * 0.5
+    surplus = np.ceil(np.maximum(metric - shed_to, 0.0)).astype(int)
+    assert surplus[0] > 0 and np.floor(upper[0] - metric[0]) >= 1, \
+        "broker 0 is not at the band edge"
+
+    cand = cgen.matched_move_candidates(g, model, arrays, con, options, 512)
+    valid = np.asarray(cand.valid)
+    assert valid.any()
+    # Leg 1 (first half) is the exact transport; leg 2 is the collision-
+    # recovery hint whose room is enforced downstream by the budgets.
+    k = valid.size // 2
+    dest = np.asarray(cand.dest)[:k][valid[:k]]
+    assert not (surplus[dest] > 0).any(), \
+        "a shedding broker received transport slots"
+
+
 def test_matched_candidates_are_legit_moves():
     model, arrays, con = build(seed=3)
     options = OptimizationOptions.none(model)

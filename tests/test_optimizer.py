@@ -250,3 +250,91 @@ def test_band_budgets_subsume_band_accepts():
     arrays = BrokerArrays.from_model(m)
     ok = np.asarray(kernels.accepts_band_batch(prev, m, arrays, cand, con))
     assert ok.all(), "an applied action violates a prev goal's band accepts"
+
+
+def test_band_budgets_subsume_with_hard_dist_goal():
+    """Satellite of the subsumption contract: a HARD distribution goal in
+    the optimized set is cap-style in accepts_band_batch (upper side only —
+    its lower band must NOT be folded into the budgets' lower_max, mirroring
+    the cap_style predicate).  The vectorized _band_sides must reproduce
+    exactly that folding, so a later goal's applied step still passes the
+    oracle accepts fold with the hard goal present."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from cruise_control_tpu.analyzer import candidates as cgen
+    from cruise_control_tpu.analyzer.actions import ActionType, make_candidates
+    from cruise_control_tpu.analyzer.goals import kernels
+    from cruise_control_tpu.analyzer.goals.specs import goals_by_priority
+    from cruise_control_tpu.analyzer.state import BrokerArrays
+
+    spec_m = ClusterSpec(num_brokers=12, num_racks=4, num_topics=6,
+                         mean_partitions_per_topic=20.0, replication_factor=2,
+                         distribution="exponential", seed=11)
+    model = generate_cluster(spec_m)
+    con = BalancingConstraint.default()
+    options = OptimizationOptions.none(model)
+    ns, nd = cgen.default_num_sources(model), cgen.default_num_dests(model)
+
+    hard_dist = dataclasses.replace(
+        goals_by_priority(["NetworkInboundUsageDistributionGoal"])[0],
+        is_hard=True)
+    prev = tuple(goals_by_priority(DEFAULT_STACK[:6])) + (hard_dist,)
+    m = model
+    for i, g in enumerate(prev):
+        fix = opt._get_fixpoint_fn(g, prev[:i], con, ns, nd, 256)
+        m = fix(m, options)[0]
+    g = goals_by_priority(["ReplicaDistributionGoal"])[0]
+    step = opt._get_step_fn(g, prev, con, ns, nd)
+    new_m, n = step(m, options)
+    assert int(n) > 0
+
+    rb0 = np.asarray(m.replica_broker)
+    rb1 = np.asarray(new_m.replica_broker)
+    moved = np.nonzero(rb0 != rb1)[0]
+    assert moved.size > 0
+    replica = jnp.asarray(moved, jnp.int32)
+    dest = jnp.asarray(rb1[moved], jnp.int32)
+    k = int(replica.shape[0])
+    cand = make_candidates(
+        m, replica, dest,
+        jnp.full((k,), ActionType.INTER_BROKER_REPLICA_MOVEMENT, jnp.int32),
+        jnp.full((k,), -1, jnp.int32), jnp.ones((k,), bool))
+    arrays = BrokerArrays.from_model(m)
+    ok = np.asarray(kernels.accepts_band_batch(prev, m, arrays, cand, con))
+    assert ok.all(), \
+        "an applied action violates the band accepts with a hard dist goal"
+
+
+def test_donated_optimize_matches_and_frees_buffers():
+    """optimize(donate_model=True) must produce identical proposals to the
+    non-donating path, and the donated working model's device buffers must
+    actually be consumed (input/output aliasing — this is the peak-HBM win:
+    the intermediate-model chain reuses one buffer set)."""
+    import jax
+
+    spec = ClusterSpec(num_brokers=50, num_racks=10, num_topics=12,
+                       mean_partitions_per_topic=25.0, replication_factor=3,
+                       distribution="exponential", seed=17)
+    model = jax.device_put(generate_cluster(spec))
+    stack = ["RackAwareGoal", "ReplicaCapacityGoal", "DiskCapacityGoal",
+             "ReplicaDistributionGoal"]
+
+    plain = opt.optimize(model, stack, raise_on_hard_failure=False, fused=True)
+    p_plain = props.diff(model, plain.model)
+
+    work = opt.donation_copy(model)
+    donated = opt.optimize(work, stack, raise_on_hard_failure=False,
+                           fused=True, donate_model=True)
+    p_donated = props.diff(model, donated.model)
+
+    assert p_plain == p_donated
+    # Every device leaf of the donated working model was consumed; the
+    # caller's model is untouched.
+    leaves = [l for l in jax.tree_util.tree_leaves(work)
+              if isinstance(l, jax.Array)]
+    assert leaves and all(l.is_deleted() for l in leaves)
+    assert not model.replica_broker.is_deleted()
+    # The result model is fully usable (aliased buffers, not dangling).
+    assert int(np.asarray(donated.model.broker_replica_counts()).sum()) > 0

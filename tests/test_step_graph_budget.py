@@ -1,0 +1,44 @@
+"""Tier-1 jaxpr-size budget for the analyzer hot path.
+
+The per-goal fixpoint's wall-clock on TPU tracks the length of the serial
+op chain inside its ``lax.while_loop`` body (every equation is a small op
+at the op-launch floor).  The step-graph diet (step-invariant band/topic
+sides hoisted to fixpoint entry, host-side constant tensors, unified move
+builder, scatter-min rank tables) took the representative mid-stack body
+from 2638 to 1921 equations; this test pins a ceiling so the body cannot
+silently regrow equation-by-equation as goals evolve.
+
+Equation counts are shape-independent (tools/step_graph_report.py measures
+identical numbers at 8 and 50 brokers), so the tiny fixture here traces in
+seconds while guarding the real TPU shapes.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from tools.step_graph_report import report  # noqa: E402
+
+# Current body count is 1921; the ceiling is the PR's acceptance bar (25%
+# under the pre-diet 2638).  Raising it needs an explicit decision, not a
+# drive-by regression.
+BODY_EQUATION_CEILING = 1978
+# Hoisting moves work OUTSIDE the loop (paid once per fixpoint dispatch) —
+# currently 350 equations.  A loose lid keeps "hoist everything, twice"
+# from silently bloating the once-per-dispatch prelude either.
+OUTER_EQUATION_CEILING = 700
+
+
+def test_step_graph_body_within_budget():
+    rec = report(goal="ReplicaDistributionGoal", brokers=8, racks=4,
+                 topics=6, mean_ppt=12.0, rf=3)
+    assert rec["body_equations"] <= BODY_EQUATION_CEILING, (
+        f"while_loop body grew to {rec['body_equations']} equations "
+        f"(ceiling {BODY_EQUATION_CEILING}).  Every equation here runs "
+        f"once per STEP — hoist step-invariant work into "
+        f"compute_step_invariants or precompute host-side constants; see "
+        f"'Hot-path anatomy & perf budget' in docs/DESIGN_ANALYZER.md.")
+    assert rec["outer_equations"] <= OUTER_EQUATION_CEILING, (
+        f"fixpoint prelude grew to {rec['outer_equations']} equations "
+        f"(ceiling {OUTER_EQUATION_CEILING})")

@@ -1,0 +1,159 @@
+"""Jaxpr op-chain budget report for the analyzer hot path.
+
+The per-goal fixpoint is ONE ``lax.while_loop`` dispatch; its wall-clock on
+TPU is dominated by the length of the serial op chain inside the loop BODY
+(each equation is a small op at the op-launch floor, not a FLOP-bound
+kernel).  This tool traces a representative mid-stack goal step and counts
+jaxpr equations three ways:
+
+- ``body_equations``   — equations inside the fixpoint's while_loop body
+  (the true per-step cost; hoisted step-invariant work leaves this count);
+- ``outer_equations``  — equations of the fixpoint program OUTSIDE the loop
+  body (paid once per fixpoint — where hoisted work lands);
+- ``step_equations``   — the standalone jitted step graph (what
+  ``_get_step_fn`` compiles; computes its own invariants, so hoisting
+  barely moves it).
+
+Counts are recursive (sub-jaxprs of cond/scan/while/pjit count too) and
+shape-independent, so the paired tier-1 budget test
+(tests/test_step_graph_budget.py) pins the same numbers on a tiny model.
+
+Usage:
+    env PYTHONPATH=/root/repo python tools/step_graph_report.py
+    ... [--goal ReplicaDistributionGoal] [--brokers 50] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from functools import partial
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # never init the tunneled TPU here
+
+
+def count_equations(jaxpr) -> int:
+    """Recursive equation count: every eqn plus all equations of any
+    sub-jaxpr carried in its params (while/cond/scan/pjit bodies)."""
+    total = 0
+    for eqn in jaxpr.eqns:
+        total += 1
+        for v in eqn.params.values():
+            total += _count_param(v)
+    return total
+
+
+def _count_param(v) -> int:
+    inner = _as_jaxpr(v)
+    if inner is not None:
+        return count_equations(inner)
+    if isinstance(v, (list, tuple)):
+        return sum(_count_param(x) for x in v)
+    return 0
+
+
+def _as_jaxpr(v):
+    jaxpr = getattr(v, "jaxpr", None)  # ClosedJaxpr
+    if jaxpr is not None and hasattr(jaxpr, "eqns"):
+        return jaxpr
+    if hasattr(v, "eqns"):  # raw Jaxpr
+        return v
+    return None
+
+
+def _find_while_body(jaxpr):
+    """The fixpoint's top-level while_loop body sub-jaxpr."""
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "while":
+            return _as_jaxpr(eqn.params["body_jaxpr"])
+        # The fixpoint trace may wrap the while in a pjit-style sub-jaxpr.
+        for v in eqn.params.values():
+            inner = _as_jaxpr(v)
+            if inner is not None:
+                body = _find_while_body(inner)
+                if body is not None:
+                    return body
+    return None
+
+
+DEFAULT_PREV = (
+    "RackAwareGoal", "ReplicaCapacityGoal", "DiskCapacityGoal",
+    "NetworkInboundCapacityGoal", "NetworkOutboundCapacityGoal",
+    "CpuCapacityGoal",
+)
+
+
+def report(goal: str = "ReplicaDistributionGoal",
+           prev: tuple = DEFAULT_PREV,
+           brokers: int = 50, racks: int = 10, topics: int = 40,
+           mean_ppt: float = 84.0, rf: int = 3, max_steps: int = 256) -> dict:
+    from cruise_control_tpu.analyzer import candidates as cgen
+    from cruise_control_tpu.analyzer import optimizer as opt
+    from cruise_control_tpu.analyzer.balancing_constraint import BalancingConstraint
+    from cruise_control_tpu.analyzer.goals.specs import goals_by_priority
+    from cruise_control_tpu.analyzer.state import OptimizationOptions
+    from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster
+
+    spec_m = ClusterSpec(num_brokers=brokers, num_racks=racks,
+                         num_topics=topics, mean_partitions_per_topic=mean_ppt,
+                         replication_factor=rf, distribution="exponential",
+                         seed=2026)
+    model = generate_cluster(spec_m)
+    options = OptimizationOptions.none(model)
+    constraint = BalancingConstraint.default()
+    g = goals_by_priority([goal])[0]
+    prev_specs = tuple(goals_by_priority(list(prev)))
+    ns = cgen.default_num_sources(model)
+    nd = cgen.default_num_dests(model)
+
+    fix = partial(opt._goal_fixpoint, spec=g, prev_specs=prev_specs,
+                  constraint=constraint, num_sources=ns, num_dests=nd,
+                  max_steps=max_steps)
+    fix_jaxpr = jax.make_jaxpr(fix)(model, options).jaxpr
+    body = _find_while_body(fix_jaxpr)
+    if body is None:
+        raise RuntimeError("no while_loop found in the fixpoint jaxpr")
+    body_eqns = count_equations(body)
+    fix_eqns = count_equations(fix_jaxpr)
+
+    step = partial(opt._goal_step, spec=g, prev_specs=prev_specs,
+                   constraint=constraint, num_sources=ns, num_dests=nd)
+    step_eqns = count_equations(jax.make_jaxpr(step)(model, options).jaxpr)
+
+    return {
+        "goal": goal,
+        "prev_specs": len(prev_specs),
+        "num_brokers": brokers,
+        "num_sources": ns,
+        "num_dests": nd,
+        "body_equations": body_eqns,
+        "outer_equations": fix_eqns - body_eqns,
+        "fixpoint_equations": fix_eqns,
+        "step_equations": step_eqns,
+    }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--goal", default="ReplicaDistributionGoal")
+    p.add_argument("--brokers", type=int, default=50)
+    p.add_argument("--json", action="store_true",
+                   help="emit one JSON line only")
+    args = p.parse_args()
+    rec = report(goal=args.goal, brokers=args.brokers)
+    if args.json:
+        print(json.dumps(rec), flush=True)
+        return
+    print(f"goal: {rec['goal']}  (prev_specs={rec['prev_specs']}, "
+          f"B={rec['num_brokers']}, ns={rec['num_sources']}, "
+          f"nd={rec['num_dests']})")
+    print(f"  while_loop body equations : {rec['body_equations']}")
+    print(f"  outside-loop equations    : {rec['outer_equations']}")
+    print(f"  fixpoint total            : {rec['fixpoint_equations']}")
+    print(f"  standalone step total     : {rec['step_equations']}")
+
+
+if __name__ == "__main__":
+    main()
