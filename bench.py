@@ -32,6 +32,13 @@ mid-compile — round-3's capture died this way):
   to ``BENCH_PARTIAL.jsonl`` and echoed to stderr IMMEDIATELY, so a later
   wedge cannot erase earlier results; the final stdout line carries every
   completed rung.
+- The whole process runs under a TOTAL budget (``BENCH_TOTAL_BUDGET_S``,
+  default 540 s — deliberately inside the driver's 600 s kill) measured
+  from first exec across the one init re-exec.  On expiry the final JSON
+  line is emitted from whatever completed (``_completed``, falling back to
+  ``BENCH_PARTIAL.jsonl``), so an outer SIGKILL at 600 s can no longer
+  produce rc=124 with parsed:null: the bench always beats the harness to
+  the exit.  Per-phase deadlines are clamped to the remaining total.
 """
 
 from __future__ import annotations
@@ -77,28 +84,68 @@ def _emit_and_exit(payload: dict, rc: int) -> None:
     os._exit(rc)
 
 
+def _final_payload(completed=None) -> dict:
+    """The single stdout JSON line, built from whatever rungs completed.
+    Falls back to re-reading BENCH_PARTIAL.jsonl so even a watchdog firing
+    in a state where ``_completed`` was lost (e.g. after a re-exec) still
+    reports every flushed rung."""
+    completed = list(_completed) if completed is None else list(completed)
+    if not completed:
+        try:
+            with open(_PARTIAL_PATH) as f:
+                completed = [json.loads(ln) for ln in f if ln.strip()]
+        except (OSError, ValueError):
+            completed = []
+    if not completed:
+        return {"metric": "bench_error", "value": -1.0, "unit": "s",
+                "vs_baseline": 0.0, "error": "no_rung_completed"}
+    headline = next((r for r in completed
+                     if r.get("metric", "").endswith("_mid")), completed[-1])
+    out = dict(headline)
+    if len(completed) > 1:
+        out["rungs"] = completed
+    return out
+
+
+def _emit_final(rc: int, **extra) -> None:
+    out = _final_payload()
+    out.update(extra)
+    # Incomplete-but-parseable beats rc=124 with nothing: exit 0 whenever at
+    # least one rung made it into the line.
+    _emit_and_exit(out, rc if out.get("metric") == "bench_error" else rc and 0)
+
+
+def _budget_deadline() -> float:
+    """Absolute epoch deadline for the WHOLE bench, sticky across the one
+    init re-exec (BENCH_T0 rides the environment)."""
+    t0 = float(os.environ.setdefault("BENCH_T0", repr(time.time())))
+    total = float(os.environ.get("BENCH_TOTAL_BUDGET_S", "540"))
+    return t0 + total
+
+
+def _budget_remaining() -> float:
+    return _budget_deadline() - time.time()
+
+
 def _watchdog(seconds: float, phase: str, retry_exec: bool = False):
-    """Arm a deadline for one phase; returns cancel().  On expiry: either
+    """Arm a deadline for one phase; returns cancel().  The effective
+    deadline is clamped to the remaining TOTAL budget so the sum of phase
+    watchdogs can never outlive the harness kill.  On expiry: either
     re-exec the process for one fresh attempt (``retry_exec``, backend init
-    only) or emit a diagnostic JSON line carrying every completed rung and
-    exit 3."""
+    only, and only if enough total budget remains to be worth it) or emit
+    the final JSON line carrying every completed rung."""
+    remaining = max(_budget_remaining(), 1.0)
+    seconds = min(seconds, remaining)
 
     def fire():
-        if retry_exec and os.environ.get("BENCH_RETRY") != "1":
+        if (retry_exec and os.environ.get("BENCH_RETRY") != "1"
+                and _budget_remaining() > 60.0):
             os.environ["BENCH_RETRY"] = "1"
             sys.stderr.write(f"bench: {phase} deadline ({seconds:.0f}s) hit; "
                              "re-execing for one retry\n")
             sys.stderr.flush()
             os.execv(sys.executable, [sys.executable] + sys.argv)
-        _emit_and_exit({
-            "metric": "bench_error",
-            "value": -1.0,
-            "unit": "s",
-            "vs_baseline": 0.0,
-            "error": phase,
-            "timeout_s": seconds,
-            "rungs": _completed,
-        }, 3)
+        _emit_final(3, error=phase, timeout_s=round(seconds, 1))
 
     t = threading.Timer(seconds, fire)
     t.daemon = True
@@ -236,6 +283,10 @@ def main() -> None:
     rung_timeout = (args.rung_timeout if args.rung_timeout is not None
                     else float(os.environ.get("BENCH_RUNG_TIMEOUT_S", "1800")))
 
+    # Backstop for any gap the phase watchdogs don't cover: the TOTAL
+    # deadline always gets the final JSON line out before the harness kill.
+    _watchdog(_budget_remaining(), "total_budget_exhausted")
+
     # Phase 1: backend init under a deadline, one re-exec retry.
     cancel = _watchdog(init_timeout, "backend_unavailable", retry_exec=True)
     t_init = time.monotonic()
@@ -257,12 +308,7 @@ def main() -> None:
 
     # One final stdout line: the headline rung (mid when present, else the
     # last completed) with every rung's record attached.
-    headline = next((r for r in _completed
-                     if r["metric"].endswith("_mid")), _completed[-1])
-    out = dict(headline)
-    if len(_completed) > 1:
-        out["rungs"] = _completed
-    print(json.dumps(out), flush=True)
+    _emit_final(0)
 
 
 if __name__ == "__main__":

@@ -83,7 +83,7 @@ def _finish_move_legs(model: TensorClusterModel, arrays: BrokerArrays,
 def _cross_move_legs(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
                      constraint: BalancingConstraint, options: OptimizationOptions,
                      num_sources: int, num_dests: int,
-                     relevance=None, bands=None):
+                     relevance=None, bands=None, active=None):
     """(replica, dest, ok), each [S·D] — the top-S × top-D cross legs."""
     if relevance is None:
         relevance = kernels.source_replica_relevance(spec, model, arrays,
@@ -92,6 +92,8 @@ def _cross_move_legs(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerAr
     room = kernels.dest_room(spec, model, arrays, constraint, bands=bands)
     # Destinations must be able to receive replicas at all.
     room = jnp.where(_recv_ok(arrays, options), room, -jnp.inf)
+    if active is not None:
+        room = jnp.where(active, room, -jnp.inf)
     _, dest_brokers = jax.lax.top_k(room, num_dests)  # [D]
 
     replica = jnp.repeat(src_replicas, num_dests)          # [K]
@@ -114,7 +116,7 @@ def move_candidates(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArr
 def _matched_move_legs(spec: GoalSpec, model: TensorClusterModel,
                        arrays: BrokerArrays, constraint: BalancingConstraint,
                        options: OptimizationOptions, num_out: int,
-                       relevance=None, bands=None):
+                       relevance=None, bands=None, active=None):
     """(replica, dest, ok), each [2·num_out] — the transport-matched legs
     (see matched_move_candidates for the semantics)."""
     B = model.num_brokers
@@ -140,6 +142,12 @@ def _matched_move_legs(spec: GoalSpec, model: TensorClusterModel,
     # legitimacy mask then discards, wasting matched throughput exactly at
     # the band edges the match exists for.
     room_n = jnp.where(src_n > 0, 0, room_n)
+    if active is not None:
+        # Frontier compaction: the transport match only sources from and
+        # lands on the active set — inactive brokers are in-band with no
+        # pull pressure, so they neither shed nor owe room this chunk.
+        src_n = jnp.where(active, src_n, 0)
+        room_n = jnp.where(active, room_n, 0)
 
     # Rank each replica within its broker (stable sort by broker; invalid
     # replicas sort last) so exactly the first over_n[b] replicas of broker
@@ -344,22 +352,25 @@ def combined_move_candidates(spec: GoalSpec, model: TensorClusterModel,
                              arrays: BrokerArrays, constraint: BalancingConstraint,
                              options: OptimizationOptions, cross_sources: int,
                              num_dests: int, num_matched: int = 0,
-                             relevance=None, bands=None) -> Candidates:
+                             relevance=None, bands=None, active=None) -> Candidates:
     """ONE move batch combining the cross legs with the goal's matched legs
     (replica- or topic-distribution transport match, when ``num_matched`` >
     0).  Building them as one batch shares the relevance ranking, the
     legitimacy mask and make_candidates' delta math across all legs — the
-    separate-builders path paid each of those twice per step."""
+    separate-builders path paid each of those twice per step.  ``active``
+    (the frontier mask, bool[B]) restricts sources and destinations to the
+    active broker set; topic legs never see it (topic goals are not band
+    kinds, so the frontier never engages there)."""
     if relevance is None:
         relevance = kernels.source_replica_relevance(spec, model, arrays,
                                                      constraint, bands=bands)
     replica, dest, ok = _cross_move_legs(
         spec, model, arrays, constraint, options, cross_sources, num_dests,
-        relevance=relevance, bands=bands)
+        relevance=relevance, bands=bands, active=active)
     if num_matched > 0 and spec.kind == "replica_distribution":
         r2, d2, ok2 = _matched_move_legs(
             spec, model, arrays, constraint, options, num_matched,
-            relevance=relevance, bands=bands)
+            relevance=relevance, bands=bands, active=active)
     elif num_matched > 0 and spec.kind == "topic_replica_distribution":
         r2, d2, ok2 = _matched_topic_legs(
             spec, model, arrays, constraint, options, num_matched,
@@ -502,7 +513,7 @@ def default_num_swap_partners(model: TensorClusterModel) -> int:
 def swap_candidates(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
                     constraint: BalancingConstraint, options: OptimizationOptions,
                     num_out: int, num_in: int,
-                    relevance=None, bands=None) -> Candidates:
+                    relevance=None, bands=None, active=None) -> Candidates:
     """K = S_out·S_in inter-broker replica-SWAP candidates.
 
     The reference's pairwise swap search walks an over-utilized broker's
@@ -524,6 +535,8 @@ def swap_candidates(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArr
     room = kernels.dest_room(spec, model, arrays, constraint, bands=bands)
     recv_ok = arrays.alive & ~options.broker_excluded_replica_move
     room = jnp.where(recv_ok, room, -jnp.inf)
+    if active is not None:
+        room = jnp.where(active, room, -jnp.inf)
     metric_res = spec.resource if spec.resource >= 0 else 3
     size = model.replica_load()[:, metric_res]
     size_scale = jnp.maximum(size.max(), 1e-9)

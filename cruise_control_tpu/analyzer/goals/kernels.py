@@ -837,6 +837,70 @@ _BAND_KINDS = ("capacity", "resource_distribution", "replica_capacity",
                "potential_nw_out", "leader_bytes_in")
 
 
+def frontier_active(spec: GoalSpec, model: TensorClusterModel,
+                    arrays: BrokerArrays, constraint: BalancingConstraint) -> Array:
+    """bool[B] — the brokers that can matter to this band goal's next steps.
+
+    The active set mirrors the kernels that source and sink the goal's
+    actions (band kinds only; structural kinds keep the dense path):
+
+    - out-of-band brokers (``violated_brokers`` semantics, dead-with-replicas
+      included) — the shedders and the needy;
+    - pull donors: in-band brokers above the band midpoint while some broker
+      sits below the lower limit (``source_pressure``'s donor term), taken
+      in descending-surplus order until their cumulative surplus covers 2x
+      the total under-band deficit — without this gate, ANY broker above
+      the midpoint is a donor while one straggler sits under band, and the
+      active set stays over half the cluster through the whole tail;
+    - receivers: alive brokers with headroom under the upper limit, taken in
+      descending-room order until their cumulative room covers 2x the total
+      remaining surplus — bounding the receiver set by the remaining
+      imbalance instead of the cluster size.
+
+    The mask is a *performance* hint, not a correctness gate: the chunk
+    driver (optimizer.frontier_fixpoint) always confirms a compacted
+    convergence with a dense chunk before declaring the goal finished.
+    """
+    B = model.num_brokers
+    metric = broker_metric(spec, model, arrays, constraint)
+    lower, upper = limits(spec, model, arrays, constraint)
+    eps = _metric_epsilon(spec)
+    over = arrays.alive & (metric > upper + eps)
+    under = arrays.alive & (metric < lower - eps)
+    dead = (~arrays.alive) & arrays.valid & (arrays.replica_count > 0)
+    under_exists = under.any()
+    # Pull donors shed to the band midpoint (neutralized for cap-only goals
+    # whose upper side is the _BIG sentinel, as in source_pressure).
+    target = jnp.where(upper >= _BIG, metric, (lower + upper) * 0.5)
+    shed_to = jnp.where(under_exists, jnp.minimum(target, upper), upper)
+    donor = arrays.alive & (metric > shed_to + eps)
+    # Remaining surplus: what the shedders (incl. dead brokers' full load)
+    # still have to place somewhere.
+    surplus = jnp.where(arrays.alive, jnp.maximum(metric - shed_to, 0.0), 0.0)
+    surplus = surplus + jnp.where(dead, jnp.maximum(metric, 0.0), 0.0)
+    total_surplus = surplus.sum()
+    # Gate pull donors by the remaining under-band deficit: the biggest
+    # donors whose cumulative surplus covers 2x what the under-band brokers
+    # still need (over-band brokers stay active via `over` regardless).
+    deficit = jnp.where(under, jnp.maximum(lower - metric, 0.0), 0.0)
+    total_deficit = deficit.sum()
+    dsur = jnp.where(donor, surplus, 0.0)
+    dorder = jnp.argsort(-dsur)
+    dsur_sorted = dsur[dorder]
+    dcum_before = jnp.cumsum(dsur_sorted) - dsur_sorted
+    donor_sorted = (dcum_before < 2.0 * total_deficit) & (dsur_sorted > 0.0)
+    donor = jnp.zeros((B,), bool).at[dorder].set(donor_sorted)
+    room = jnp.where(arrays.alive & ~over,
+                     jnp.maximum(jnp.minimum(upper, _BIG) - metric, 0.0), 0.0)
+    order = jnp.argsort(-room)
+    room_sorted = room[order]
+    cum_before = jnp.cumsum(room_sorted) - room_sorted
+    recv_sorted = (cum_before < 2.0 * total_surplus) & (room_sorted > 0.0)
+    receivers = jnp.zeros((B,), bool).at[order].set(recv_sorted)
+    receivers = receivers & (total_surplus > 0.0)
+    return over | under | dead | donor | receivers
+
+
 def is_band_kind(spec: GoalSpec) -> bool:
     """Specs whose accepts() is the generic band check (metric/limits/delta
     math on the broker axis) — batchable across specs."""

@@ -72,6 +72,26 @@ class StepInvariants:
 
 
 @struct.dataclass
+class FrontierInvariants:
+    """The *active frontier* of one goal's chunked fixpoint: the brokers that
+    can still matter to the goal's next steps (outside the band, donors of
+    the pull phase, the receivers covering the remaining surplus, dead
+    brokers still hosting replicas) plus the index maps between the full
+    broker axis and a compacted axis bucketed to a power of two.  Computed
+    at each chunk boundary by ``optimizer.frontier_fixpoint`` (the mask is
+    ``kernels.frontier_active``; bucketing bounds recompiles to ~log2(B)
+    shapes); the step then runs its candidate batches and selection segment
+    spaces over the compacted axis and scatters accepted actions back into
+    the full model through the candidates' full broker ids.  The compacted
+    axis length (``full_of_compact.shape[0]``) is the bucket — shape, not a
+    static field, so the jit trace specializes on it."""
+
+    active: Array           # bool[B] — full-axis membership mask
+    compact_of_full: Array  # i32[B] — compact id per broker, -1 when inactive
+    full_of_compact: Array  # i32[Bc] — full broker id per compact slot, -1 pad
+
+
+@struct.dataclass
 class OptimizationOptions:
     """Traced per-request constraints (analyzer/OptimizationOptions.java:16).
 

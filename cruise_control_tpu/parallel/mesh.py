@@ -106,3 +106,26 @@ def distributed_optimize_goal(model: TensorClusterModel, spec: GoalSpec,
                                 mesh=mesh)
     model, steps, total, _, _, _ = fixpoint(model, options)
     return model, int(steps), int(total)
+
+
+def distributed_frontier_fixpoint(model: TensorClusterModel, spec: GoalSpec,
+                                  prev_specs: Tuple[GoalSpec, ...],
+                                  constraint: BalancingConstraint,
+                                  options: OptimizationOptions, mesh: Mesh,
+                                  max_steps: int = 256, chunk_steps: int = 32,
+                                  num_sources: Optional[int] = None,
+                                  num_dests: Optional[int] = None,
+                                  on_chunk=None, frontier: bool = True):
+    """Shrinking-frontier chunk driver under the device mesh: identical
+    orchestration to ``optimizer.frontier_fixpoint`` (frontier mask probe at
+    each chunk boundary, power-of-two compaction buckets, adaptive chunk
+    length, dense confirm) with every dispatch — the mask probe and the
+    budget fixpoint — lowered through GSPMD over ``mesh``.  The compaction
+    index maps are tiny host tensors; GSPMD replicates them and shards the
+    candidate batch exactly as the dense sharded step does.  Returns
+    ``(model, info)`` — see frontier_fixpoint."""
+    from cruise_control_tpu.analyzer.optimizer import frontier_fixpoint
+    return frontier_fixpoint(model, options, spec, prev_specs, constraint,
+                             num_sources=num_sources, num_dests=num_dests,
+                             max_steps=max_steps, chunk_steps=chunk_steps,
+                             mesh=mesh, frontier=frontier, on_chunk=on_chunk)

@@ -1,0 +1,334 @@
+"""Shrinking-frontier stepping: compaction equivalence, bucket policy,
+executable reuse, the fused satisfied-sweep, and real per-goal wall times.
+
+The frontier path must be invisible at tier-1 sizes (B <= _FRONTIER_DENSE_MIN
+runs the dense program — literally the same executable), and outcome-
+equivalent when compaction actually engages: same converged satisfaction,
+same invariants, with a dense confirm chunk guarding the mask.  Everything
+here runs B=16 models and short stacks to stay inside the suite's compile
+budget; the mid-rung tail benchmark is the slow-marked smoke at the end.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from cruise_control_tpu.analyzer import optimizer as opt  # noqa: E402
+from cruise_control_tpu.analyzer.balancing_constraint import (  # noqa: E402
+    BalancingConstraint,
+)
+from cruise_control_tpu.analyzer.goals import kernels  # noqa: E402
+from cruise_control_tpu.analyzer.goals.specs import goals_by_priority  # noqa: E402
+from cruise_control_tpu.analyzer.state import (  # noqa: E402
+    BrokerArrays,
+    OptimizationOptions,
+)
+from cruise_control_tpu.model.generator import (  # noqa: E402
+    ClusterSpec,
+    generate_cluster,
+)
+
+GOAL = "ReplicaDistributionGoal"
+
+
+def _build(seed: int = 7, brokers: int = 16):
+    spec = ClusterSpec(num_brokers=brokers, num_racks=4, num_topics=5,
+                       mean_partitions_per_topic=40.0, replication_factor=2,
+                       distribution="exponential", seed=seed)
+    return generate_cluster(spec)
+
+
+def _skewed_model(seed: int = 7, brokers: int = 16):
+    """One over-band broker, everyone else inside the band: the frontier is
+    the surplus broker plus the receivers covering 2x its surplus — a small
+    active set, so compaction engages once the dense floor is lowered."""
+    model = _build(seed=seed, brokers=brokers)
+    rb = np.asarray(model.replica_broker)
+    rv = np.asarray(model.replica_valid)
+    cnt = np.bincount(rb[rv], minlength=brokers)
+    total = int(cnt.sum())
+    avg, r = total // brokers, total % brokers
+    target = np.full(brokers, avg)
+    target[0] = avg + r
+    pool = [list(np.nonzero(rv & (rb == b))[0]) for b in range(brokers)]
+    moves, dests = [], []
+    for b in range(brokers):
+        moves += [pool[b].pop() for _ in range(max(cnt[b] - target[b], 0))]
+        dests += [b] * max(target[b] - cnt[b], 0)
+    return model.relocate_replicas(jnp.asarray(np.array(moves), jnp.int32),
+                                   jnp.asarray(np.array(dests), jnp.int32),
+                                   jnp.ones(len(moves), bool))
+
+
+def test_frontier_bucket_policy():
+    # Below the dense floor the bucket is always None — tier-1 sizes never
+    # leave the dense executable.
+    for b in (3, 16, 50, opt._FRONTIER_DENSE_MIN):
+        assert opt._frontier_bucket(1, b) is None
+        assert opt._frontier_bucket(b // 2, b) is None
+
+    # Above the floor: buckets are powers of two >= the floor, strictly
+    # smaller than B, dense once the active set covers over half the
+    # cluster — so at most ~log2(B) distinct compacted shapes per goal.
+    B = 1024
+    buckets = set()
+    for na in range(1, B + 1):
+        bk = opt._frontier_bucket(na, B)
+        if bk is None:
+            assert 2 * na > B or bk is None
+            continue
+        assert bk >= opt._FRONTIER_DENSE_MIN
+        assert bk & (bk - 1) == 0  # power of two
+        assert bk < B
+        assert bk >= na
+        buckets.add(bk)
+    assert len(buckets) <= int(np.log2(B))
+
+    # Candidate widths shrink with the bucket but keep exploration floors.
+    ns, nd = 2048, 875
+    cns, cnd = opt._frontier_widths(64, ns, nd)
+    assert cns == 256 and cnd == 64
+    assert opt._frontier_widths(8, ns, nd) == (32, 8)
+    # Never wider than the dense widths.
+    for bk in (64, 128, 256, 512):
+        cns, cnd = opt._frontier_widths(bk, ns, nd)
+        assert cns <= ns and cnd <= nd
+
+
+def test_frontier_auto_is_dense_at_tier1_sizes():
+    """B=16 <= _FRONTIER_DENSE_MIN: the frontier driver must produce the
+    bit-identical proposal stream of the dense driver (same executable,
+    the mask probe only adds an early-exit that cannot change results)."""
+    model = _build()
+    con = BalancingConstraint.default()
+    g = goals_by_priority([GOAL])[0]
+    options = OptimizationOptions.none(model)
+    m1, i1 = opt.frontier_fixpoint(model, options, g, (), con,
+                                   max_steps=64, chunk_steps=8, frontier=True)
+    m2, i2 = opt.frontier_fixpoint(model, options, g, (), con,
+                                   max_steps=64, chunk_steps=8, frontier=False)
+    assert i1["buckets"] == []  # never compacts below the floor
+    assert i1["steps"] == i2["steps"]
+    assert i1["actions"] == i2["actions"]
+    assert bool(jnp.all(m1.replica_broker == m2.replica_broker))
+    assert bool(jnp.all(m1.replica_is_leader == m2.replica_is_leader))
+
+
+def test_forced_compaction_outcome_equivalence(monkeypatch):
+    """With the dense floor lowered, the skewed model's small frontier picks
+    a real compaction bucket; the compacted chunks must converge to a
+    satisfied goal with model invariants intact, and the driver must close
+    with a dense confirm chunk (the mask is a hint, not a gate)."""
+    monkeypatch.setattr(opt, "_FRONTIER_DENSE_MIN", 8)
+    model = _skewed_model()
+    con = BalancingConstraint.default()
+    g = goals_by_priority([GOAL])[0]
+    arrays = BrokerArrays.from_model(model)
+    active = np.asarray(kernels.frontier_active(g, model, arrays, con))
+    assert 0 < active.sum() <= 8, "skew recipe must keep the frontier small"
+    assert not bool(kernels.goal_satisfied(g, model, arrays, con))
+
+    options = OptimizationOptions.none(model)
+    m1, i1 = opt.frontier_fixpoint(model, options, g, (), con,
+                                   max_steps=64, chunk_steps=8, frontier=True)
+    m2, i2 = opt.frontier_fixpoint(model, options, g, (), con,
+                                   max_steps=64, chunk_steps=8, frontier=False)
+
+    assert i1["buckets"] == [8]
+    assert any(c["bucket"] == 8 for c in i1["chunks"])
+    # Compacted widths recorded on the compacted chunk.
+    c8 = next(c for c in i1["chunks"] if c["bucket"] == 8)
+    assert (c8["ns"], c8["nd"]) == opt._frontier_widths(
+        8, *(i2["chunks"][0]["ns"], i2["chunks"][0]["nd"]))
+    # Compacted convergence is confirmed dense before the goal is declared
+    # done.
+    assert i1["chunks"][-1]["bucket"] is None
+    assert i1["satisfied_after"] and i2["satisfied_after"]
+    assert i1["actions"] > 0
+    for m in (m1, m2):
+        a = BrokerArrays.from_model(m)
+        assert bool(kernels.goal_satisfied(g, m, a, con))
+        assert bool(jnp.all(m.replica_valid == model.replica_valid))
+
+
+def test_chunk_driver_reuses_one_executable_per_bucket_shape():
+    """The traced step budget means chunk lengths 32/16/8/4 share ONE
+    compiled executable; a forced compaction bucket adds exactly one more.
+    (tools/step_graph_report.py --chunk-reuse is the standalone version.)"""
+    model = _build()
+    con = BalancingConstraint.default()
+    g = goals_by_priority([GOAL])[0]
+    options = OptimizationOptions.none(model)
+    from cruise_control_tpu.analyzer import candidates as cgen
+    ns = cgen.default_num_sources(model)
+    nd = cgen.default_num_dests(model)
+
+    fn = opt._get_budget_fixpoint_fn(g, (), con, ns, nd)
+    for budget in (32, 16, 8, 4):
+        _, packed = fn(model, options, budget, None)
+        jax.block_until_ready(packed)
+    assert fn._cache_size() == 1
+
+    bucket = 8
+    active = np.zeros((model.num_brokers,), bool)
+    active[:4] = True
+    fr = opt._build_frontier(active, bucket)
+    cns, cnd = opt._frontier_widths(bucket, ns, nd)
+    fn_b = opt._get_budget_fixpoint_fn(g, (), con, cns, cnd)
+    for budget in (8, 4):
+        _, packed = fn_b(model, options, budget, fr)
+        jax.block_until_ready(packed)
+    # Exactly one trace for the bucket-8 shape — even counting any earlier
+    # test in this module that drove the same (goal, bucket) through the
+    # driver (shared cache key = shared executable, which is the point).
+    assert fn_b._cache_size() == 1
+
+
+def test_fused_sweep_skips_satisfied_goals_and_durations_are_real():
+    """fuse_group_size=1: one jitted sweep answers "already satisfied?" for
+    the whole stack; satisfied goals never enter their fixpoint program, the
+    per-goal wall times are real measurements (not total/len), and the
+    results match the unfused reference bit-for-bit."""
+    model = _build(seed=11)
+    goals = ["RackAwareGoal", "ReplicaCapacityGoal", GOAL,
+             "LeaderReplicaDistributionGoal"]
+    before = dict(opt.SWEEP_COUNTERS)
+    t0 = time.monotonic()
+    fused = opt.optimize(model, goals, fused=True, fuse_group_size=1,
+                         raise_on_hard_failure=False)
+    wall = time.monotonic() - t0
+    unfused = opt.optimize(model, goals, raise_on_hard_failure=False)
+
+    assert bool(jnp.all(fused.model.replica_broker
+                        == unfused.model.replica_broker))
+    assert bool(jnp.all(fused.model.replica_is_leader
+                        == unfused.model.replica_is_leader))
+    for gf, gu in zip(fused.goal_results, unfused.goal_results):
+        assert (gf.name, gf.steps, gf.actions_applied,
+                gf.satisfied_after) == (gu.name, gu.steps, gu.actions_applied,
+                                        gu.satisfied_after)
+
+    # The sweep dispatched at least once and skipped the already-satisfied
+    # goals without entering their fixpoint.
+    assert opt.SWEEP_COUNTERS["dispatches"] > before["dispatches"]
+    skipped = [g for g in fused.goal_results
+               if g.steps == 0 and g.satisfied_after]
+    if skipped:
+        assert (opt.SWEEP_COUNTERS["skipped_goals"]
+                > before["skipped_goals"])
+
+    # Real per-goal durations: non-negative, distinct across goals that did
+    # different amounts of work, and summing to no more than the measured
+    # wall (the old fused path divided one wall equally — every goal
+    # identical).
+    durations = [g.duration_s for g in fused.goal_results]
+    assert all(d >= 0.0 for d in durations)
+    assert len(set(durations)) > 1
+    assert sum(durations) <= wall + 0.25
+    # Goals that ran steps on the group==1 path carry their chunk records.
+    ran = [g for g in fused.goal_results if g.steps > 0]
+    assert ran and all(g.chunks for g in ran)
+
+
+def test_bench_final_payload(tmp_path, monkeypatch):
+    """The bench must always be able to assemble its final stdout line:
+    from completed rungs, else from BENCH_PARTIAL.jsonl, else a parseable
+    error record — never nothing (the rc=124/parsed:null failure mode)."""
+    import json
+
+    import bench
+
+    monkeypatch.setattr(bench, "_completed", [])
+    monkeypatch.setattr(bench, "_PARTIAL_PATH", str(tmp_path / "missing"))
+    out = bench._final_payload()
+    assert out["metric"] == "bench_error"
+    assert out["error"] == "no_rung_completed"
+
+    small = {"metric": "wall_clock_to_goal_satisfying_proposal_small",
+             "value": 1.0}
+    mid = {"metric": "wall_clock_to_goal_satisfying_proposal_mid",
+           "value": 2.0}
+    monkeypatch.setattr(bench, "_completed", [small, mid])
+    out = bench._final_payload()
+    assert out["metric"].endswith("_mid")  # headline prefers the mid rung
+    assert out["rungs"] == [small, mid]
+
+    # A wedge that lost _completed still recovers every flushed rung.
+    partial = tmp_path / "partial.jsonl"
+    partial.write_text(json.dumps(small) + "\n" + json.dumps(mid) + "\n")
+    monkeypatch.setattr(bench, "_completed", [])
+    monkeypatch.setattr(bench, "_PARTIAL_PATH", str(partial))
+    out = bench._final_payload()
+    assert out["metric"].endswith("_mid")
+    assert out["rungs"] == [small, mid]
+
+
+def test_tail_report_summary():
+    from tools.tail_report import tail_summary
+
+    record = {
+        "metric": "sharded_1m_fixpoint",
+        "per_goal": {
+            "GoalA": {"steps": 64, "actions": 1030, "wall_s": 40.0,
+                      "chunks": [
+                          {"steps": 32, "actions": 1000, "wall_s": 10.0},
+                          {"steps": 32, "actions": 30, "wall_s": 30.0},
+                      ]},
+            "GoalB": {"steps": 4, "actions": 7, "wall_s": 1.5},  # no chunks
+        },
+    }
+    rep = tail_summary(record, tail_frac=0.1)
+    a = next(g for g in rep["goals"] if g["goal"] == "GoalA")
+    # Chunk 2 admits 30/32 < 0.1 * (1000/32) actions/step -> tail.
+    assert a["tail_chunks"] == 1
+    assert a["tail_wall_s"] == 30.0
+    assert a["tail_fraction"] == 0.75
+    b = next(g for g in rep["goals"] if g["goal"] == "GoalB")
+    assert b["tail_fraction"] is None  # chunk-less records stay reportable
+    assert rep["tail_wall_s"] == 30.0
+    assert rep["tail_fraction"] == 0.75
+
+
+@pytest.mark.slow
+def test_midrung_convergence_tail_below_ceiling():
+    """Mid-rung smoke (excluded from tier-1 by the slow marker): on a
+    skewed 192-broker model the frontier driver's convergence tail — wall
+    spent in chunks admitting <10% of the peak actions/step rate — must
+    stay below a pinned ceiling of the dense driver's tail."""
+    from tools.tail_report import tail_summary
+
+    model = _skewed_model(seed=5, brokers=192)
+    con = BalancingConstraint.default()
+    g = goals_by_priority([GOAL])[0]
+    options = OptimizationOptions.none(model)
+
+    def run(frontier):
+        m, info = opt.frontier_fixpoint(model, options, g, (), con,
+                                        max_steps=128, chunk_steps=16,
+                                        frontier=frontier)
+        rec = {"metric": "midrung", "per_goal": {GOAL: {
+            "steps": info["steps"], "actions": info["actions"],
+            "wall_s": sum(c["wall_s"] for c in info["chunks"]),
+            "chunks": info["chunks"]}}}
+        return info, tail_summary(rec)
+
+    info_f, rep_f = run(True)
+    info_d, rep_d = run(False)
+    assert info_f["satisfied_after"] and info_d["satisfied_after"]
+    assert info_f["buckets"], "mid-rung skew must engage compaction"
+    tail_f = rep_f["tail_wall_s"]
+    tail_d = rep_d["tail_wall_s"]
+    if tail_d > 1.0:  # only meaningful when the dense tail is measurable
+        assert tail_f <= 0.5 * tail_d, (tail_f, tail_d)
+    # And the frontier run's own tail share stays below the pinned ceiling.
+    if rep_f["tail_fraction"] is not None:
+        assert rep_f["tail_fraction"] <= 0.6, rep_f

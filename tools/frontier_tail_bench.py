@@ -1,0 +1,226 @@
+"""Mid-rung CPU benchmark: shrinking-frontier vs fixed-chunk convergence tail.
+
+Reproduces the 1M-rung pathology (SHARDED_1M_r05.json: 36% of the 9,600 s
+wall sat in chunks admitting <10% of the peak actions/step) at a CPU-sized
+rung and measures what the frontier driver reclaims.  The model is a
+natural exponential-imbalance cluster with extra surplus piled onto a few
+brokers: the broad imbalance gives the high-accept-rate head, the surplus
+brokers give the long shed tail where the active frontier is a handful of
+brokers but the fixed-chunk driver keeps paying full-width candidate
+batches (at B=384: 1536x48 dense lanes vs 256x48 in a bucket-64 chunk).
+
+Baseline = the recorded production behavior: fixed 32-step chunks through
+``_get_fixpoint_fn`` re-dispatched while capped (exactly the
+tools/sharded_fixpoint.py legacy loop).  Contender =
+``optimizer.frontier_fixpoint`` (mask probe, compaction buckets, adaptive
+chunk length, dense confirm).  Tail wall follows tools/tail_report.py:
+chunks whose actions/step rate is below 10% of the goal's peak.
+
+Writes FRONTIER_TAIL.json at the repo root and prints one JSON line.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/frontier_tail_bench.py
+Environment:
+    TAIL_BROKERS / TAIL_TOPICS / TAIL_MPPT  model shape (default 384/40/300)
+    TAIL_SURPLUS_BROKERS / TAIL_SURPLUS     skew (default 16 brokers, +48)
+    TAIL_CHUNK / TAIL_MAX_CHUNKS            chunking (default 32 / 32)
+    TAIL_GOAL                               goal (default
+                                            DiskUsageDistributionGoal — the
+                                            worst tail in the 1M record:
+                                            60% of 1,594 s)
+    TAIL_THRESHOLD                          balance threshold override for
+                                            every resource + count band
+                                            (default 1.02: a tight band is
+                                            what makes production tails
+                                            grind — the default 1.1 band at
+                                            this rung converges in one
+                                            chunk with no tail at all)
+    TAIL_OUT                                output path
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def build_model():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster
+
+    nb = int(os.environ.get("TAIL_BROKERS", "384"))
+    nt = int(os.environ.get("TAIL_TOPICS", "40"))
+    mppt = float(os.environ.get("TAIL_MPPT", "300.0"))
+    n_surplus = int(os.environ.get("TAIL_SURPLUS_BROKERS", "16"))
+    surplus = int(os.environ.get("TAIL_SURPLUS", "48"))
+
+    spec = ClusterSpec(num_brokers=nb, num_racks=max(2, nb // 48),
+                       num_topics=nt, mean_partitions_per_topic=mppt,
+                       replication_factor=2, distribution="exponential",
+                       seed=2026)
+    model = generate_cluster(spec)
+
+    # Pile extra surplus on the first n_surplus brokers, pulled evenly from
+    # the rest: the shed tail the frontier driver exists for.
+    rb = np.asarray(model.replica_broker)
+    rv = np.asarray(model.replica_valid)
+    pool = [list(np.nonzero(rv & (rb == b))[0]) for b in range(nb)]
+    moves, dests = [], []
+    donors = [b for b in range(n_surplus, nb)]
+    di = 0
+    for b in range(n_surplus):
+        for _ in range(surplus):
+            for _ in range(len(donors)):
+                d = donors[di % len(donors)]
+                di += 1
+                if len(pool[d]) > 1:
+                    moves.append(pool[d].pop())
+                    dests.append(b)
+                    break
+    model = model.relocate_replicas(
+        jnp.asarray(np.array(moves), jnp.int32),
+        jnp.asarray(np.array(dests), jnp.int32),
+        jnp.ones(len(moves), bool))
+    return model, nb
+
+
+def main() -> None:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from cruise_control_tpu.analyzer import candidates as cgen
+    from cruise_control_tpu.analyzer import optimizer as opt
+    from cruise_control_tpu.analyzer.balancing_constraint import BalancingConstraint
+    from cruise_control_tpu.analyzer.goals.specs import goals_by_priority
+    from cruise_control_tpu.analyzer.state import OptimizationOptions
+    from tools.tail_report import tail_summary
+
+    t_build = time.monotonic()
+    model, nb = build_model()
+    options = OptimizationOptions.none(model)
+    import dataclasses
+    th = float(os.environ.get("TAIL_THRESHOLD", "1.02"))
+    constraint = dataclasses.replace(
+        BalancingConstraint.default(),
+        resource_balance_threshold=(th, th, th, th),
+        replica_count_balance_threshold=th,
+        leader_replica_count_balance_threshold=th)
+    g = goals_by_priority([os.environ.get("TAIL_GOAL",
+                                          "DiskUsageDistributionGoal")])[0]
+    ns = cgen.default_num_sources(model)
+    nd = cgen.default_num_dests(model)
+    chunk = int(os.environ.get("TAIL_CHUNK", "32"))
+    max_chunks = int(os.environ.get("TAIL_MAX_CHUNKS", "32"))
+    print(f"model: B={nb} R={int(model.replica_valid.sum())} ns={ns} nd={nd} "
+          f"({time.monotonic() - t_build:.1f}s)", flush=True)
+
+    def summarize(chunks, label):
+        rec = {"metric": label, "per_goal": {g.name: {
+            "steps": sum(c["steps"] for c in chunks),
+            "actions": sum(c["actions"] for c in chunks),
+            "wall_s": sum(c["wall_s"] for c in chunks),
+            "chunks": chunks}}}
+        return tail_summary(rec)
+
+    # ---- warm-up: compile both drivers' executables off the clock ------
+    # (bench.py does the same — the metric is steady-state wall, and at the
+    # big rungs chunk walls are 100+ s while compiles amortize away; here a
+    # 3 s compile would swamp a 0.2 s tail chunk.)  The warm frontier run
+    # visits the same deterministic bucket sequence the timed run will.
+    t0 = time.monotonic()
+    fix = opt._get_fixpoint_fn(g, (), constraint, ns, nd, chunk)
+    jax.block_until_ready(fix(model, options)[0])
+    opt.frontier_fixpoint(model, options, g, (), constraint,
+                          num_sources=ns, num_dests=nd,
+                          max_steps=chunk * max_chunks, chunk_steps=chunk)
+    print(f"warm-up done ({time.monotonic() - t0:.1f}s)", flush=True)
+
+    # ---- baseline: fixed chunks, full-width every chunk ----------------
+    base_chunks = []
+    capped = True
+    sat_after = False
+    m = model
+    while capped and len(base_chunks) < max_chunks:
+        t0 = time.monotonic()
+        out = fix(m, options)
+        jax.block_until_ready(out[0])
+        wall = time.monotonic() - t0
+        m = out[0]
+        s, a, _, aft, cap = (int(out[i]) for i in range(1, 6))
+        capped = bool(cap)
+        sat_after = bool(aft)
+        base_chunks.append({"steps": s, "actions": a,
+                            "wall_s": round(wall, 2)})
+        print(f"baseline chunk {len(base_chunks)}: steps={s} actions={a} "
+              f"wall={wall:.1f}s", flush=True)
+    base = summarize(base_chunks, "fixed_chunk_baseline")
+    base["satisfied_after"] = sat_after
+
+    # ---- contender: shrinking-frontier driver --------------------------
+    def on_chunk(_m, rec):
+        print(f"frontier chunk: steps={rec['steps']} "
+              f"actions={rec['actions']} bucket={rec['bucket']} "
+              f"ns={rec['ns']} nd={rec['nd']} wall={rec['wall_s']:.1f}s",
+              flush=True)
+
+    mf, info = opt.frontier_fixpoint(
+        model, options, g, (), constraint, num_sources=ns, num_dests=nd,
+        max_steps=chunk * max_chunks, chunk_steps=chunk, on_chunk=on_chunk)
+    front_chunks = [{"steps": c["steps"], "actions": c["actions"],
+                     "wall_s": round(c["wall_s"], 2), "bucket": c["bucket"],
+                     "ns": c["ns"], "nd": c["nd"]} for c in info["chunks"]]
+    front = summarize(front_chunks, "frontier")
+    front["satisfied_after"] = bool(info["satisfied_after"])
+    front["buckets"] = info["buckets"]
+
+    def tail_of(rep):
+        return rep["goals"][0]["tail_wall_s"]
+
+    base_tail, front_tail = tail_of(base), tail_of(front)
+    record = {
+        "metric": "frontier_tail_midrung",
+        "num_brokers": nb,
+        "num_replicas": int(model.replica_valid.sum()),
+        "chunk_steps": chunk,
+        "goal": g.name,
+        "baseline": {"chunks": base_chunks,
+                     "wall_s": base["total_wall_s"],
+                     "tail_wall_s": base_tail,
+                     "tail_fraction": base["tail_fraction"],
+                     "satisfied_after": base["satisfied_after"]},
+        "frontier": {"chunks": front_chunks,
+                     "wall_s": front["total_wall_s"],
+                     "tail_wall_s": front_tail,
+                     "tail_fraction": front["tail_fraction"],
+                     "buckets": front["buckets"],
+                     "satisfied_after": front["satisfied_after"]},
+        "tail_speedup": (round(base_tail / front_tail, 2)
+                         if front_tail > 0 else None),
+        "wall_speedup": round(base["total_wall_s"] /
+                              max(front["total_wall_s"], 1e-9), 2),
+    }
+    out_path = os.environ.get("TAIL_OUT",
+                              os.path.join(REPO, "FRONTIER_TAIL.json"))
+    with open(out_path, "w") as f:
+        f.write(json.dumps(record) + "\n")
+    headline = {k: record[k] for k in ("metric", "num_brokers",
+                                       "tail_speedup", "wall_speedup")}
+    headline["baseline_tail_s"] = base_tail
+    headline["frontier_tail_s"] = front_tail
+    headline["baseline_wall_s"] = base["total_wall_s"]
+    headline["frontier_wall_s"] = front["total_wall_s"]
+    print(json.dumps(headline), flush=True)
+
+
+if __name__ == "__main__":
+    main()

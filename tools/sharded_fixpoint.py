@@ -16,6 +16,10 @@ Usage:
 Environment:
     FIXPOINT_CHUNK      steps per chunk (default 32)
     FIXPOINT_MAX_CHUNKS safety valve per goal (default 64 -> 2048 steps)
+    FIXPOINT_FRONTIER   "0" disables the shrinking-frontier driver (default
+                        on: band goals run optimizer.frontier_fixpoint —
+                        per-chunk frontier compaction + adaptive chunk
+                        length — with the same checkpoint cadence)
     FIXPOINT_STATE      checkpoint dir (default <repo>/.fixpoint_state)
     SHARDED_OUT         final record path (default SHARDED_1M_r05.json)
     SHARDED_GOALS / SHARDED_NS / SHARDED_ND as in sharded_1m.py
@@ -103,10 +107,12 @@ def main():
         "SHARDED_GOALS", ",".join(STACK)).split(",") if g]
     chunk = int(os.environ.get("FIXPOINT_CHUNK", "32"))
     max_chunks = int(os.environ.get("FIXPOINT_MAX_CHUNKS", "64"))
+    use_frontier = os.environ.get("FIXPOINT_FRONTIER", "1") != "0"
     ns = int(os.environ.get("SHARDED_NS", "0")) or cgen.default_num_sources(model)
     nd = int(os.environ.get("SHARDED_ND", "0")) or cgen.default_num_dests(model)
     print(f"stack={len(goal_names)} goals ns={ns} nd={nd} "
-          f"chunk={chunk} max_chunks={max_chunks}", flush=True)
+          f"chunk={chunk} max_chunks={max_chunks} frontier={use_frontier}",
+          flush=True)
 
     def save_state(elapsed):
         np.savez(ckpt_path + ".tmp.npz",
@@ -131,8 +137,6 @@ def main():
         if name in done_names:
             prev = prev + (gspec,)
             continue
-        fix = opt._get_fixpoint_fn(gspec, prev, constraint, ns, nd,
-                                   chunk, mesh=mesh)
         steps = actions = n_chunks = 0
         before0 = None
         chunks = []
@@ -154,29 +158,74 @@ def main():
             aft = int(cur.get("satisfied_after", 0))
             print(f"{name}: resuming mid-goal at chunk {n_chunks + 1}",
                   flush=True)
-        while capped and n_chunks < max_chunks:
-            t0 = time.monotonic()
-            out = fix(model, options)
-            jax.block_until_ready(out[0])
-            wall = time.monotonic() - t0
-            model = out[0]
-            s, a, b, aft, cap = (int(out[i]) for i in range(1, 6))
-            if before0 is None:
-                before0 = bool(b)
-            steps += s
-            actions += a
-            n_chunks += 1
-            capped = bool(cap)
-            chunks.append({"steps": s, "actions": a, "wall_s": round(wall, 1)})
-            progress["current"] = {"name": name, "chunks": chunks,
-                                   "satisfied_before": before0,
-                                   "satisfied_after": int(aft),
-                                   "capped": capped}
-            elapsed = base_elapsed + (time.monotonic() - t_round)
-            print(f"{name} chunk {n_chunks}: steps={s} actions={a} "
-                  f"capped={capped} satisfied={bool(aft)} "
-                  f"wall={wall:.0f}s total={elapsed:.0f}s", flush=True)
-            save_state(elapsed)
+        if use_frontier:
+            # Shrinking-frontier driver: the chunk loop lives in
+            # optimizer.frontier_fixpoint (mask probe, compaction buckets,
+            # adaptive chunk length, dense confirm); on_chunk keeps the
+            # checkpoint cadence of the legacy loop.  The remaining step
+            # budget seeds from the recorded chunks so resume is exact.
+            budget = chunk * max_chunks - steps
+            if capped and budget > 0:
+                def on_chunk(m, rec):
+                    nonlocal model, n_chunks
+                    model = m
+                    n_chunks += 1
+                    chunks.append({"steps": rec["steps"],
+                                   "actions": rec["actions"],
+                                   "wall_s": round(rec["wall_s"], 1),
+                                   "bucket": rec["bucket"],
+                                   "ns": rec["ns"], "nd": rec["nd"]})
+                    progress["current"] = {
+                        "name": name, "chunks": chunks,
+                        "satisfied_before": before0,
+                        "satisfied_after": 0, "capped": True}
+                    elapsed = base_elapsed + (time.monotonic() - t_round)
+                    print(f"{name} chunk {n_chunks}: steps={rec['steps']} "
+                          f"actions={rec['actions']} bucket={rec['bucket']} "
+                          f"wall={rec['wall_s']:.0f}s total={elapsed:.0f}s",
+                          flush=True)
+                    save_state(elapsed)
+                model, info = pmesh.distributed_frontier_fixpoint(
+                    model, gspec, prev, constraint, options, mesh,
+                    max_steps=budget, chunk_steps=chunk,
+                    num_sources=ns, num_dests=nd, on_chunk=on_chunk)
+                if before0 is None:
+                    before0 = bool(info["satisfied_before"])
+                steps += info["steps"]
+                actions += info["actions"]
+                aft = int(info["satisfied_after"])
+                capped = bool(info["capped"])
+                progress["current"] = {"name": name, "chunks": chunks,
+                                       "satisfied_before": before0,
+                                       "satisfied_after": aft,
+                                       "capped": capped}
+        else:
+            fix = opt._get_fixpoint_fn(gspec, prev, constraint, ns, nd,
+                                       chunk, mesh=mesh)
+            while capped and n_chunks < max_chunks:
+                t0 = time.monotonic()
+                out = fix(model, options)
+                jax.block_until_ready(out[0])
+                wall = time.monotonic() - t0
+                model = out[0]
+                s, a, b, aft, cap = (int(out[i]) for i in range(1, 6))
+                if before0 is None:
+                    before0 = bool(b)
+                steps += s
+                actions += a
+                n_chunks += 1
+                capped = bool(cap)
+                chunks.append({"steps": s, "actions": a,
+                               "wall_s": round(wall, 1)})
+                progress["current"] = {"name": name, "chunks": chunks,
+                                       "satisfied_before": before0,
+                                       "satisfied_after": int(aft),
+                                       "capped": capped}
+                elapsed = base_elapsed + (time.monotonic() - t_round)
+                print(f"{name} chunk {n_chunks}: steps={s} actions={a} "
+                      f"capped={capped} satisfied={bool(aft)} "
+                      f"wall={wall:.0f}s total={elapsed:.0f}s", flush=True)
+                save_state(elapsed)
         entry = {
             "name": name, "steps": steps, "actions": actions,
             "satisfied_before": before0, "satisfied_after": bool(aft),

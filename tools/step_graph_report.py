@@ -18,9 +18,19 @@ Counts are recursive (sub-jaxprs of cond/scan/while/pjit count too) and
 shape-independent, so the paired tier-1 budget test
 (tests/test_step_graph_budget.py) pins the same numbers on a tiny model.
 
+``--chunk-reuse`` runs the second budget instead: the shrinking-frontier
+chunk driver must reuse ONE compiled executable per (goal, bucket shape) —
+the traced step budget means chunk lengths 32/16/8/4 all hit the same
+trace, and each forced compaction bucket adds exactly one more.  The
+SHARDED_1M_r05 wall-creep investigation (167→454 s per 32-step chunk)
+ruled out recompilation only by inspection; this mode pins it by count so
+a regression (e.g. a static chunk length sneaking back into the jit key)
+shows up as executables > 1 + len(buckets).
+
 Usage:
     env PYTHONPATH=/root/repo python tools/step_graph_report.py
     ... [--goal ReplicaDistributionGoal] [--brokers 50] [--json]
+    ... [--chunk-reuse]
 """
 
 from __future__ import annotations
@@ -135,13 +145,99 @@ def report(goal: str = "ReplicaDistributionGoal",
     }
 
 
+def chunk_reuse_report(goal: str = "ReplicaDistributionGoal",
+                       brokers: int = 50, racks: int = 10, topics: int = 40,
+                       mean_ppt: float = 84.0, rf: int = 3,
+                       budgets=(32, 16, 8, 4), buckets=(8, 16)) -> dict:
+    """Dispatch the budget-capped chunk program at several chunk lengths and
+    forced compaction buckets; count compiled traces via ``_cache_size``.
+    ok ⇔ dense chunks share ONE executable and each bucket adds exactly one.
+    """
+    import numpy as np
+
+    from cruise_control_tpu.analyzer import candidates as cgen
+    from cruise_control_tpu.analyzer import optimizer as opt
+    from cruise_control_tpu.analyzer.balancing_constraint import BalancingConstraint
+    from cruise_control_tpu.analyzer.goals.specs import goals_by_priority
+    from cruise_control_tpu.analyzer.state import OptimizationOptions
+    from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster
+
+    spec_m = ClusterSpec(num_brokers=brokers, num_racks=racks,
+                         num_topics=topics, mean_partitions_per_topic=mean_ppt,
+                         replication_factor=rf, distribution="exponential",
+                         seed=2026)
+    model = generate_cluster(spec_m)
+    options = OptimizationOptions.none(model)
+    constraint = BalancingConstraint.default()
+    g = goals_by_priority([goal])[0]
+    ns = cgen.default_num_sources(model)
+    nd = cgen.default_num_dests(model)
+
+    dispatches = 0
+    # Dense: every chunk length through the one traced-budget executable.
+    dense_fn = opt._get_budget_fixpoint_fn(g, (), constraint, ns, nd)
+    for budget in budgets:
+        m2, packed = dense_fn(model, options, budget, None)
+        jax.block_until_ready(packed)
+        dispatches += 1
+    dense_execs = dense_fn._cache_size()
+
+    # Forced buckets: same goal, compacted widths — one more trace each.
+    per_bucket = {}
+    for bucket in buckets:
+        active = np.zeros((brokers,), bool)
+        active[:max(2, bucket // 2)] = True
+        fr = opt._build_frontier(active, bucket)
+        cns, cnd = opt._frontier_widths(bucket, ns, nd)
+        fn = opt._get_budget_fixpoint_fn(g, (), constraint, cns, cnd)
+        size0 = fn._cache_size()
+        for budget in budgets[-2:]:
+            m2, packed = fn(model, options, budget, fr)
+            jax.block_until_ready(packed)
+            dispatches += 1
+        per_bucket[bucket] = fn._cache_size() - size0
+
+    executables = dense_execs + sum(per_bucket.values())
+    ok = (dense_execs == 1 and
+          all(v == 1 for v in per_bucket.values()))
+    return {
+        "goal": goal,
+        "num_brokers": brokers,
+        "budgets": list(budgets),
+        "buckets": list(buckets),
+        "dispatches": dispatches,
+        "dense_executables": dense_execs,
+        "per_bucket_executables": {str(k): v for k, v in per_bucket.items()},
+        "executables": executables,
+        "ok": ok,
+    }
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--goal", default="ReplicaDistributionGoal")
     p.add_argument("--brokers", type=int, default=50)
     p.add_argument("--json", action="store_true",
                    help="emit one JSON line only")
+    p.add_argument("--chunk-reuse", action="store_true",
+                   help="check the chunk driver reuses one executable per "
+                        "(goal, bucket shape) instead of the jaxpr report")
     args = p.parse_args()
+    if args.chunk_reuse:
+        rec = chunk_reuse_report(goal=args.goal, brokers=args.brokers)
+        if args.json:
+            print(json.dumps(rec), flush=True)
+        else:
+            print(f"goal: {rec['goal']}  (B={rec['num_brokers']})")
+            print(f"  dispatches                : {rec['dispatches']}")
+            print(f"  dense executables         : {rec['dense_executables']}")
+            for b, v in rec["per_bucket_executables"].items():
+                print(f"  bucket {b:>4} executables   : {v}")
+            print(f"  total executables         : {rec['executables']}")
+            print(f"  ok                        : {rec['ok']}")
+        if not rec["ok"]:
+            raise SystemExit(1)
+        return
     rec = report(goal=args.goal, brokers=args.brokers)
     if args.json:
         print(json.dumps(rec), flush=True)

@@ -1,0 +1,113 @@
+"""Convergence-tail report for bench / sharded fixpoint records.
+
+The 1M-rung capture (SHARDED_1M_r05.json) showed the classic greedy-descent
+shape: a goal's first chunks admit hundreds of actions per step, then the
+accept rate collapses while each 32-step chunk still pays full-cluster
+candidate generation — ReplicaDistributionGoal spent 167→454 s per chunk
+while admitting a dwindling handful of moves.  The shrinking-frontier
+driver exists to crush exactly that tail; this tool quantifies it.
+
+For every goal with recorded chunks the report derives the
+actions-per-step rate of each chunk, takes the goal's peak rate, and
+classifies a chunk as TAIL when its rate falls below ``tail_frac`` (default
+0.1) of the peak.  ``tail_fraction`` = tail wall / total wall — the share
+of the goal's time spent admitting almost nothing, i.e. the fraction the
+frontier path can reclaim.  Records without per-chunk data (bench.py
+per_goal entries) still report totals with ``tail_fraction: null``.
+
+Usage:
+    python tools/tail_report.py SHARDED_1M_r05.json [--tail-frac 0.1] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Optional
+
+
+def _chunk_tail(chunks: list, tail_frac: float) -> dict:
+    rates = [c["actions"] / max(c["steps"], 1) for c in chunks]
+    peak = max(rates) if rates else 0.0
+    walls = [float(c.get("wall_s", 0.0)) for c in chunks]
+    total_wall = sum(walls)
+    tail_wall = sum(w for w, r in zip(walls, rates)
+                    if peak > 0 and r < tail_frac * peak)
+    return {
+        "num_chunks": len(chunks),
+        "peak_actions_per_step": round(peak, 2),
+        "tail_chunks": sum(1 for r in rates
+                           if peak > 0 and r < tail_frac * peak),
+        "tail_wall_s": round(tail_wall, 1),
+        "tail_fraction": (round(tail_wall / total_wall, 3)
+                          if total_wall > 0 else None),
+    }
+
+
+def goal_summary(name: str, g: dict, tail_frac: float) -> dict:
+    chunks = g.get("chunks")
+    rec = {
+        "goal": name,
+        "steps": g.get("steps", 0),
+        "actions": g.get("actions", g.get("actions_applied", 0)),
+        "wall_s": round(float(g.get("wall_s", 0.0)), 1),
+    }
+    if chunks:
+        rec.update(_chunk_tail(chunks, tail_frac))
+    else:
+        rec.update({"num_chunks": 0, "peak_actions_per_step": None,
+                    "tail_chunks": 0, "tail_wall_s": 0.0,
+                    "tail_fraction": None})
+    return rec
+
+
+def tail_summary(record: dict, tail_frac: float = 0.1) -> dict:
+    """Per-goal tail breakdown of one bench / sharded record, plus the
+    record-wide tail fraction over the goals that have chunk data."""
+    per_goal = record.get("per_goal", {})
+    goals = [goal_summary(name, g, tail_frac)
+             for name, g in per_goal.items()]
+    with_chunks = [g for g in goals if g["tail_fraction"] is not None]
+    total_wall = sum(g["wall_s"] for g in with_chunks)
+    tail_wall = sum(g["tail_wall_s"] for g in with_chunks)
+    return {
+        "metric": record.get("metric"),
+        "tail_frac_threshold": tail_frac,
+        "goals": goals,
+        "total_wall_s": round(total_wall, 1),
+        "tail_wall_s": round(tail_wall, 1),
+        "tail_fraction": (round(tail_wall / total_wall, 3)
+                          if total_wall > 0 else None),
+    }
+
+
+def main(argv: Optional[list] = None) -> None:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("record", help="bench / sharded JSON record path")
+    p.add_argument("--tail-frac", type=float, default=0.1,
+                   help="chunk is tail when actions/step < frac * peak")
+    p.add_argument("--json", action="store_true", help="one JSON line only")
+    args = p.parse_args(argv)
+    with open(args.record) as f:
+        record = json.loads(f.read().strip().splitlines()[0])
+    rep = tail_summary(record, args.tail_frac)
+    if args.json:
+        print(json.dumps(rep), flush=True)
+        return
+    print(f"{'goal':<40} {'steps':>6} {'actions':>8} {'wall_s':>8} "
+          f"{'chunks':>6} {'tail_s':>8} {'tail%':>6}")
+    for g in rep["goals"]:
+        tf = (f"{100 * g['tail_fraction']:.0f}%"
+              if g["tail_fraction"] is not None else "-")
+        print(f"{g['goal']:<40} {g['steps']:>6} {g['actions']:>8} "
+              f"{g['wall_s']:>8.1f} {g['num_chunks']:>6} "
+              f"{g['tail_wall_s']:>8.1f} {tf:>6}")
+    tf = (f"{100 * rep['tail_fraction']:.0f}%"
+          if rep["tail_fraction"] is not None else "-")
+    print(f"{'TOTAL (goals with chunk data)':<40} {'':>6} {'':>8} "
+          f"{rep['total_wall_s']:>8.1f} {'':>6} {rep['tail_wall_s']:>8.1f} "
+          f"{tf:>6}")
+
+
+if __name__ == "__main__":
+    main()
