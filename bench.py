@@ -226,9 +226,33 @@ def run_rung(scale: str, max_candidates, fast: bool) -> dict:
             "steps": g.steps, "actions": g.actions_applied,
             "wall_s": round(g.duration_s, 3), "capped": g.capped,
             "satisfied_after": g.satisfied_after,
+            "repair_steps": g.repair_steps, "bisect_depth": g.bisect_depth,
+            "lanes_live": g.lanes_live,
+            **({"chunks": g.chunks} if g.chunks else {}),
         } for g in run.goal_results},
         **({"fast_mode": True} if fast else {}),
     }
+    # Flat-wall guard: with the bounded-depth repair, same-shape chunks of
+    # one goal must cost the same per step.  A slope beyond 1.5× means
+    # data-dependent work crept back into the step graph — fail the rung
+    # immediately (within the BENCH_TOTAL_BUDGET_S watchdog) rather than
+    # shipping a silently band-edge-sensitive record.
+    from tools.tail_report import wall_slope
+    slopes = {g.name: wall_slope(g.chunks)
+              for g in run.goal_results if g.chunks}
+    slopes = {name: s for name, s in slopes.items() if s is not None}
+    if slopes:
+        worst = max(slopes.values())
+        rec["wall_slope"] = worst
+        if worst > 1.5:
+            rec["wall_slope_violations"] = {
+                name: s for name, s in slopes.items() if s > 1.5}
+            rec["error"] = "wall_slope_exceeded"
+            _record_rung(rec)
+            print(json.dumps(rec), flush=True)
+            raise SystemExit(
+                f"per-chunk wall slope {worst:.2f} exceeds 1.5x "
+                f"({rec['wall_slope_violations']})")
     # Speedup over the sequential greedy baseline (the JVM-analyzer proxy:
     # tools/sequential_baseline.py, run on the identical snapshot; the
     # recorded SEQ_<scale>.json is produced by that script).

@@ -630,3 +630,15 @@ def intra_swap_candidates(spec: GoalSpec, model: TensorClusterModel,
 
 def concat_candidates(a: Candidates, b: Candidates) -> Candidates:
     return jax.tree.map(lambda x, y: jnp.concatenate([x, y], axis=0), a, b)
+
+
+def take_candidates(cand: Candidates, idx: Array) -> Candidates:
+    """Gather the candidate subset ``idx`` along the K axis (live-candidate
+    compaction: select_batched packs the lanes surviving the score /
+    feasibility / acceptance masks into a dense top-K prefix so the
+    conflict and repair rounds run on live lanes only).  Every Candidates
+    leaf is K-leading, so one tree-map covers move, leadership, intra-disk
+    and swap legs alike — and the gathered candidates keep their FULL
+    broker / partition / disk ids, so ``apply_candidates`` scatters into
+    the full model unchanged."""
+    return jax.tree.map(lambda x: x[idx], cand)

@@ -52,8 +52,9 @@ from cruise_control_tpu.analyzer.goals import kernels
 from cruise_control_tpu.analyzer.goals.specs import GoalSpec, goals_by_priority
 from cruise_control_tpu.analyzer.state import (BrokerArrays, FrontierInvariants,
                                                OptimizationOptions,
-                                               StepInvariants)
+                                               StepInvariants, pow2_bucket)
 from cruise_control_tpu.common import compile_cache
+from cruise_control_tpu.common.sensors import SENSORS
 from cruise_control_tpu.common.tracing import TRACE
 from cruise_control_tpu.model.stats import ClusterModelStats, compute_stats_jit
 from cruise_control_tpu.model.tensor_model import TensorClusterModel
@@ -75,6 +76,38 @@ SUBROUNDS = 128
 _DBG_TRIVIAL_SELECT = False
 _DBG_NO_ACCEPTS = False
 _DBG_NO_BUDGETS = False
+
+
+def _repair_oracle() -> bool:
+    """CRUISE_REPAIR_ORACLE=1 selects the legacy data-dependent repair
+    (cond-gated prefix passes + unbounded drop while_loop) for differential
+    testing against the bounded-depth exact repair.  Read by every _get_*
+    cache constructor so the flag is part of the python cache key — flipping
+    the env var mid-process selects a different executable, never a stale
+    one."""
+    return os.environ.get("CRUISE_REPAIR_ORACLE", "").strip() == "1"
+
+
+# Below this K the selection rounds always run on the full lane axis:
+# compaction buys nothing at tier-1 batch sizes, and the dense path keeps
+# "bit-identical proposals at tier-1 sizes" structural (mirrors
+# _FRONTIER_DENSE_MIN for the broker axis).
+_LANE_DENSE_MIN = 4096
+
+
+def _lane_bucket(k: int, nb_sel: int, subrounds: int) -> Optional[int]:
+    """Live-candidate compaction bucket for a K-lane batch, or None for
+    dense.  The score/feasibility/acceptance masks kill most lanes before
+    the conflict rounds, so the rounds gather the surviving lanes into a
+    dense top-K prefix of this (power-of-two, shared pow2_bucket ladder)
+    length.  Sized so a full round of lane winners always fits: each round
+    keeps at most ``subrounds`` actions per broker, and 2× headroom keeps
+    the conflict passes from starving on collision-heavy batches."""
+    if k <= _LANE_DENSE_MIN:
+        return None
+    target = min(k, max(_LANE_DENSE_MIN, 2 * nb_sel * subrounds))
+    kc = pow2_bucket(target, _LANE_DENSE_MIN)
+    return kc if kc < k else None
 
 
 class OptimizationFailureException(Exception):
@@ -339,8 +372,11 @@ def select_batched(score: Array, cand: Candidates, eligible: Array,
                    topic_budgets, disk_guard: bool,
                    rounds: int = 6, subrounds: int = 4,
                    has_swaps: bool = True,
-                   frontier: Optional[FrontierInvariants] = None) -> Array:
-    """bool[K] — greedy multi-accept subset.
+                   frontier: Optional[FrontierInvariants] = None,
+                   compact_k: Optional[int] = None,
+                   repair_oracle: bool = False):
+    """(keep bool[K], stats (repair_fired, lanes_live, bisect_depth) i32
+    scalars) — greedy multi-accept subset.
 
     Round-1's selection kept at most ONE action per source broker, per
     destination broker and per partition per step, capping throughput at
@@ -380,8 +416,64 @@ def select_batched(score: Array, cand: Candidates, eligible: Array,
     never contributes.  Budget rows gathered for pad slots (full_of_compact
     = -1 → broker 0) are harmless for the same reason: no eligible
     candidate maps to a pad slot.
+
+    ``compact_k`` gathers the lanes surviving the eligibility masks into a
+    dense top-``compact_k``-by-score prefix BEFORE the rounds (live-candidate
+    compaction): the sort/scan/scatter chains of the conflict and repair
+    rounds then run over Kc ≪ K live lanes instead of the full S×D batch.
+    The gathered candidates keep full ids, so the returned keep mask is
+    scattered back to length K for the apply.  When more than ``compact_k``
+    lanes are live the lowest-scored surplus is dropped — semantically a
+    narrower greedy batch, never a band-exactness risk.
+
+    ``repair_oracle`` selects the legacy data-dependent repair (cond-gated
+    passes + unbounded drop loop) for differential testing; the default is
+    the bounded-depth exact repair (kernels.prefix_cut_admit /
+    prefix_admit_safe): fixed alternating src/dest bisection passes plus a
+    terminal subset-closed admit — constant op count per step regardless of
+    how close the model sits to the band edges.
     """
     num_brokers, num_partitions = model.num_brokers, model.num_partitions
+    k_full = score.shape[0]
+    lanes_live = jnp.int32(0)
+    rep_fired = jnp.int32(0)
+    sel_idx = None
+    eps = 1e-6
+    # Decorrelating tie-break: _best_per_segment resolves equal scores by
+    # lowest candidate index, and the K batch is replica-major / dest-minor
+    # with destinations in one global top-D order — so for tie-heavy goals
+    # (rack conflicts, count distributions: scores are small integers) every
+    # source broker's winner picked the SAME destination, the per-dest pass
+    # then kept ONE action, and steps landed ~1 action per round regardless
+    # of batch width.  A tiny multiplicative hash-jitter (≤1e-4 relative)
+    # spreads near-tied winners across destinations without reordering
+    # meaningfully different scores.
+    # The hash bits depend only on the (static) batch width — numpy math
+    # folds them into jaxpr literals (zero equations in the loop body)
+    # instead of an 8-op uint32 chain retraced into every step.
+    # Both hashes key off the candidate's FULL-batch position and are
+    # computed BEFORE live-lane compaction, then gathered through sel_idx:
+    # a compacted step sees the same jittered scores and subround lanes as
+    # the dense step it stands in for.
+    idx_k = np.arange(k_full, dtype=np.uint32)
+    jitter = ((idx_k * np.uint32(2654435761)) >> np.uint32(12)).astype(
+        np.float32) / np.float32(1 << 20)
+    score = score * jnp.asarray(1.0 + 1e-4 * jitter)
+    # Subround lane per candidate (decorrelated from the jitter bits).
+    lane_np = (((idx_k * np.uint32(0x9E3779B9)) >> np.uint32(4)) %
+               np.uint32(subrounds)).astype(np.int32)
+    lane = jnp.asarray(lane_np)
+    if repair_oracle:
+        compact_k = None  # the oracle reproduces the pre-compaction path
+    if compact_k is not None and compact_k < k_full:
+        live = eligible
+        lanes_live = live.sum().astype(jnp.int32)
+        _, sel_idx = jax.lax.top_k(jnp.where(live, score, -jnp.inf),
+                                   compact_k)
+        cand = cgen.take_candidates(cand, sel_idx)
+        score = score[sel_idx]
+        eligible = live[sel_idx]
+        lane = lane[sel_idx]
     if frontier is not None:
         nb_sel = frontier.full_of_compact.shape[0]
         c_of_f = jnp.maximum(frontier.compact_of_full, 0)
@@ -397,27 +489,6 @@ def select_batched(score: Array, cand: Candidates, eligible: Array,
     else:
         nb_sel = num_brokers
         src_b, dest_b = cand.src, cand.dest
-    eps = 1e-6
-    # Decorrelating tie-break: _best_per_segment resolves equal scores by
-    # lowest candidate index, and the K batch is replica-major / dest-minor
-    # with destinations in one global top-D order — so for tie-heavy goals
-    # (rack conflicts, count distributions: scores are small integers) every
-    # source broker's winner picked the SAME destination, the per-dest pass
-    # then kept ONE action, and steps landed ~1 action per round regardless
-    # of batch width.  A tiny multiplicative hash-jitter (≤1e-4 relative)
-    # spreads near-tied winners across destinations without reordering
-    # meaningfully different scores.
-    # The hash bits depend only on the (static) batch width — numpy math
-    # folds them into jaxpr literals (zero equations in the loop body)
-    # instead of an 8-op uint32 chain retraced into every step.
-    idx_k = np.arange(score.shape[0], dtype=np.uint32)
-    jitter = ((idx_k * np.uint32(2654435761)) >> np.uint32(12)).astype(
-        np.float32) / np.float32(1 << 20)
-    score = score * jnp.asarray(1.0 + 1e-4 * jitter)
-    # Subround lane per candidate (decorrelated from the jitter bits).
-    lane_np = (((idx_k * np.uint32(0x9E3779B9)) >> np.uint32(4)) %
-               np.uint32(subrounds)).astype(np.int32)
-    lane = jnp.asarray(lane_np)
     src_lane = src_b * subrounds + lane
     dest_lane = dest_b * subrounds + lane
     # Cross-round accumulators materialize lazily: round 1 knows they are
@@ -588,26 +659,41 @@ def select_batched(score: Array, cand: Candidates, eligible: Array,
 
             hi_tb = jnp.stack([gain_rep, jnp.full_like(gain_rep, jnp.inf)], 1)
             lo_tb = jnp.stack([-shed_rep, -shed_lead], 1)
+            cum_tb = jnp.stack([cum_rep, cum_lead], 1)
 
-            def _tb_repair(k):
-                # Score-ranked prefix per violating key (same granularity
-                # fix as the broker-channel repair: single-best fallbacks
-                # made hot (topic, broker) pairs drain 1 action/step).
-                vt = tb_viol(k)
-                cum_tb = jnp.stack([cum_rep, cum_lead], 1)
+            if repair_oracle:
+                def _tb_repair(k):
+                    # Score-ranked prefix per violating key (same granularity
+                    # fix as the broker-channel repair: single-best fallbacks
+                    # made hot (topic, broker) pairs drain 1 action/step).
+                    vt = tb_viol(k)
+                    for i in range(num_legs):
+                        contrib = leg_contrib(i, k)
+                        admit = _prefix_admit_role(
+                            score, leg_keys[i],
+                            jnp.stack([d_rep[i], d_lead[i]], 1),
+                            contrib, cum_tb, lo_tb, hi_tb, n_tb)
+                        k = k & (~(contrib & vt[leg_keys[i]]) | admit)
+                    return k
+
+                # The legacy path gates the passes behind a cond — branch
+                # divergence traded away per-step flatness for skipping the
+                # common in-room case.
+                keep = jax.lax.cond(tb_viol(keep).any(), _tb_repair,
+                                    lambda k: k, keep)
+            else:
+                # Bounded repair: the per-key exact cuts ALWAYS run — they
+                # are masked no-ops on rounds with no violating key, so the
+                # per-step cost is constant instead of band-edge-dependent.
+                vt = tb_viol(keep)
+                rep_fired = rep_fired + vt.any().astype(jnp.int32)
                 for i in range(num_legs):
-                    contrib = leg_contrib(i, k)
-                    admit = _prefix_admit_role(
+                    contrib = leg_contrib(i, keep)
+                    admit = kernels.prefix_cut_admit(
                         score, leg_keys[i],
                         jnp.stack([d_rep[i], d_lead[i]], 1),
                         contrib, cum_tb, lo_tb, hi_tb, n_tb)
-                    k = k & (~(contrib & vt[leg_keys[i]]) | admit)
-                return k
-
-            # The repair passes run only when some key actually overshot —
-            # the common case (lanes within room) skips them entirely.
-            keep = jax.lax.cond(tb_viol(keep).any(), _tb_repair,
-                                lambda k: k, keep)
+                    keep = keep & (~(contrib & vt[leg_keys[i]]) | admit)
 
         def net_viol(k):
             total = cum_net + round_net(k)
@@ -627,35 +713,86 @@ def select_batched(score: Array, cand: Candidates, eligible: Array,
         # PREFIX of its actions that still fits the remaining budgets (per
         # role; the old single-best fallback produced 1-action/step
         # convergence tails at band edges — 16 such steps in the mid rung's
-        # ReplicaDistribution fixpoint); any broker STILL violating —
-        # including brokers flipped into violation by another broker's
-        # drops (removing one leg of a compensating pair raises the
-        # partner's net) — sheds ALL its actions until no violation
-        # remains.  The loop is monotone (a violating broker always has a
-        # kept action to drop, since cum_net alone respects the bounds by
-        # induction), so it terminates and the post-step state respects
-        # every band exactly.  The whole block is conditional: steps whose
-        # lane winners fit their budgets (the common case) skip every
-        # repair pass.
-        def _broker_repair(k):
-            v = net_viol(k)
-            admit_d = _prefix_admit_role(score, dest_b, d_dest, k, cum_net,
-                                         -slack_src, room_dest, nb_sel)
-            k = k & (~v[dest_b] | admit_d)
-            v = net_viol(k)
-            admit_s = _prefix_admit_role(score, src_b, d_src, k, cum_net,
-                                         -slack_src, room_dest, nb_sel)
-            k = k & (~v[src_b] | admit_s)
+        # ReplicaDistribution fixpoint).
+        if repair_oracle:
+            # Legacy repair: a data-dependent drop loop sheds ALL actions of
+            # any broker still violating — including brokers flipped into
+            # violation by another broker's drops (removing one leg of a
+            # compensating pair raises the partner's net) — until no
+            # violation remains.  Monotone (a violating broker always has a
+            # kept action to drop, since cum_net alone respects the bounds
+            # by induction), so it terminates, but its trip count is
+            # data-dependent: band-edge states pay extra sequential
+            # iterations.  Kept verbatim behind CRUISE_REPAIR_ORACLE=1 as
+            # the differential-test oracle.
+            def _broker_repair(k):
+                v = net_viol(k)
+                admit_d = _prefix_admit_role(score, dest_b, d_dest, k, cum_net,
+                                             -slack_src, room_dest, nb_sel)
+                k = k & (~v[dest_b] | admit_d)
+                v = net_viol(k)
+                admit_s = _prefix_admit_role(score, src_b, d_src, k, cum_net,
+                                             -slack_src, room_dest, nb_sel)
+                k = k & (~v[src_b] | admit_s)
 
-            def _drop_violators(kk):
-                vv = net_viol(kk)
-                return kk & ~vv[src_b] & ~vv[dest_b]
+                def _drop_violators(kk):
+                    vv = net_viol(kk)
+                    return kk & ~vv[src_b] & ~vv[dest_b]
 
-            return jax.lax.while_loop(lambda kk: net_viol(kk).any(),
-                                      _drop_violators, k)
+                return jax.lax.while_loop(lambda kk: net_viol(kk).any(),
+                                          _drop_violators, k)
 
-        keep = jax.lax.cond(net_viol(keep).any(), _broker_repair,
-                            lambda k: k, keep)
+            keep = jax.lax.cond(net_viol(keep).any(), _broker_repair,
+                                lambda k: k, keep)
+        else:
+            # Bounded-depth exact repair: a FIXED number of alternating
+            # (dest, src) prefix-cut passes absorbs the direct violations
+            # (each cut is the bisection over "zero bad prefix positions" —
+            # identical to the legacy admit's cut), then ONE subset-closed
+            # safe admit terminates the flip cascade without any loop: it
+            # bounds each broker's admitted Σd⁺ ≤ hi−cum and Σd⁻ ≥ lo−cum
+            # *separately* across BOTH roles (2K concatenated elements), so
+            # any subset of the admitted set — in particular the one left
+            # after intersecting the per-candidate role copies — still fits
+            # every channel.  Every pass is masked to violating segments and
+            # the terminal trim is gated on a residual violation, so
+            # violation-free steps are bit-identical to the legacy path.
+            v0 = net_viol(keep)
+            rep_fired = rep_fired + v0.any().astype(jnp.int32)
+            v = v0
+            for _ in range(2):
+                admit_d = kernels.prefix_cut_admit(
+                    score, dest_b, d_dest, keep, cum_net,
+                    -slack_src, room_dest, nb_sel)
+                keep = keep & (~v[dest_b] | admit_d)
+                v = net_viol(keep)
+                admit_s = kernels.prefix_cut_admit(
+                    score, src_b, d_src, keep, cum_net,
+                    -slack_src, room_dest, nb_sel)
+                keep = keep & (~v[src_b] | admit_s)
+                v = net_viol(keep)
+            any_left = v.any()
+            kk = score.shape[0]
+            safe2 = kernels.prefix_admit_safe(
+                jnp.concatenate([score, score]),
+                jnp.concatenate([src_b, dest_b]),
+                jnp.concatenate([d_src, d_dest], axis=0),
+                jnp.concatenate([keep, keep]),
+                cum_net, -slack_src, room_dest, nb_sel)
+            safe = safe2[:kk] & safe2[kk:]
+            if topic_on:
+                safe_t = kernels.prefix_admit_safe(
+                    jnp.concatenate([score] * num_legs),
+                    jnp.concatenate([leg_keys[i] for i in range(num_legs)]),
+                    jnp.concatenate(
+                        [jnp.stack([d_rep[i], d_lead[i]], 1)
+                         for i in range(num_legs)], axis=0),
+                    jnp.concatenate(
+                        [leg_contrib(i, keep) for i in range(num_legs)]),
+                    cum_tb, lo_tb, hi_tb, n_tb).reshape(num_legs, kk)
+                for i in range(num_legs):
+                    safe = safe & (~leg_contrib(i, keep) | safe_t[i])
+            keep = jnp.where(any_left, keep & safe, keep)
 
         keep_total = keep if first else keep_total | keep
         if last:
@@ -684,7 +821,13 @@ def select_batched(score: Array, cand: Candidates, eligible: Array,
             touches = keep & (cand.dest_disk >= 0)
             used_sdisk = used_sdisk.at[jnp.where(touches, safe_sd, 0)].max(touches)
             used_ddisk = used_ddisk.at[jnp.where(touches, safe_dd, 0)].max(touches)
-    return keep_total
+    if sel_idx is not None:
+        # Scatter the compacted keep decisions back onto the full candidate
+        # axis (dead lanes were never winners, so plain scatter suffices).
+        keep_total = jnp.zeros((k_full,), bool).at[sel_idx].set(keep_total)
+    stats = (rep_fired, lanes_live,
+             jnp.int32(kernels.bisect_depth(score.shape[0])))
+    return keep_total, stats
 
 
 # ---------------------------------------------------------------------------
@@ -815,8 +958,13 @@ def _goal_step(model: TensorClusterModel, options: OptimizationOptions,
                constraint: BalancingConstraint,
                num_sources: int, num_dests: int, mesh=None,
                invariants: Optional[StepInvariants] = None,
-               frontier: Optional[FrontierInvariants] = None):
-    """One optimization step for ``spec``: returns (new_model, num_applied).
+               frontier: Optional[FrontierInvariants] = None,
+               repair_oracle: bool = False):
+    """One optimization step for ``spec``: returns
+    ``(new_model, num_applied, sel_stats)`` where ``sel_stats`` is the
+    selection's ``(repair_fired, lanes_live, bisect_depth)`` i32 scalars
+    (see select_batched).  ``repair_oracle`` selects the legacy
+    data-dependent repair path (CRUISE_REPAIR_ORACLE=1).
 
     Static args (spec, prev_specs, constraint, widths, mesh) select the
     compiled graph; model/options are traced.  With ``mesh`` set, the
@@ -967,15 +1115,21 @@ def _goal_step(model: TensorClusterModel, options: OptimizationOptions,
     rounds = max(1, -(-int(constraint.moves_per_broker_step) // subrounds))
     if _DBG_TRIVIAL_SELECT:
         keep = _best_per_segment(score, jnp.zeros(cand.k, jnp.int32), 1, eligible)
+        sel_stats = (jnp.int32(0), jnp.int32(0), jnp.int32(0))
     else:
-        keep = select_batched(score, cand, eligible, model, room_dest, slack_src,
-                              topic_budgets, disk_guard, rounds=rounds,
-                              subrounds=subrounds,
-                              has_swaps=bool(spec.uses_swaps
-                                             or spec.uses_intra_swaps),
-                              frontier=frontier)
+        nb_sel_static = (frontier.full_of_compact.shape[0]
+                         if frontier is not None else model.num_brokers)
+        compact_k = (None if repair_oracle
+                     else _lane_bucket(cand.k, nb_sel_static, subrounds))
+        keep, sel_stats = select_batched(
+            score, cand, eligible, model, room_dest, slack_src,
+            topic_budgets, disk_guard, rounds=rounds,
+            subrounds=subrounds,
+            has_swaps=bool(spec.uses_swaps or spec.uses_intra_swaps),
+            frontier=frontier, compact_k=compact_k,
+            repair_oracle=repair_oracle)
     new_model = apply_candidates(model, cand, keep)
-    return new_model, keep.sum()
+    return new_model, keep.sum(), sel_stats
 
 
 _step_cache: Dict[tuple, object] = {}
@@ -1013,12 +1167,15 @@ def _persist_token(kind: str, key: tuple, *trees) -> Optional[str]:
 def _get_step_fn(spec: GoalSpec, prev_specs: Tuple[GoalSpec, ...],
                  constraint: BalancingConstraint, num_sources: int, num_dests: int,
                  mesh=None, donate: bool = False):
-    key = (spec, prev_specs, constraint, num_sources, num_dests, mesh, donate)
+    oracle = _repair_oracle()
+    key = (spec, prev_specs, constraint, num_sources, num_dests, mesh, donate,
+           oracle)
     fn = _step_cache.get(key)
     if fn is None:
         fn = jax.jit(partial(_goal_step, spec=spec, prev_specs=prev_specs,
                              constraint=constraint, num_sources=num_sources,
-                             num_dests=num_dests, mesh=mesh),
+                             num_dests=num_dests, mesh=mesh,
+                             repair_oracle=oracle),
                      donate_argnums=(0,) if donate else ())
         _step_cache[key] = fn
     return fn
@@ -1031,7 +1188,8 @@ def _get_step_fn(spec: GoalSpec, prev_specs: Tuple[GoalSpec, ...],
 def _goal_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
                    spec: GoalSpec, prev_specs: Tuple[GoalSpec, ...],
                    constraint: BalancingConstraint, num_sources: int,
-                   num_dests: int, max_steps: int, mesh=None):
+                   num_dests: int, max_steps: int, mesh=None,
+                   repair_oracle: bool = False):
     """Run ``spec`` to its fixpoint entirely on device.
 
     The reference's hot loop (GoalOptimizer.java:417-492 →
@@ -1069,8 +1227,10 @@ def _goal_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
 
     def body(state):
         m, steps, total, _ = state
-        new_m, n = _goal_step(m, options, spec, prev_specs, constraint,
-                              num_sources, num_dests, mesh, invariants=inv)
+        new_m, n, _sel = _goal_step(m, options, spec, prev_specs, constraint,
+                                    num_sources, num_dests, mesh,
+                                    invariants=inv,
+                                    repair_oracle=repair_oracle)
         n = n.astype(jnp.int32)
         return (new_m, steps + 1, total + n, n)
 
@@ -1090,13 +1250,15 @@ def _get_fixpoint_fn(spec: GoalSpec, prev_specs: Tuple[GoalSpec, ...],
                      constraint: BalancingConstraint, num_sources: int,
                      num_dests: int, max_steps: int, mesh=None,
                      donate: bool = False):
+    oracle = _repair_oracle()
     key = (spec, prev_specs, constraint, num_sources, num_dests, max_steps,
-           mesh, donate)
+           mesh, donate, oracle)
     fn = _fixpoint_cache.get(key)
     if fn is None:
         fn = jax.jit(partial(_goal_fixpoint, spec=spec, prev_specs=prev_specs,
                              constraint=constraint, num_sources=num_sources,
-                             num_dests=num_dests, max_steps=max_steps, mesh=mesh),
+                             num_dests=num_dests, max_steps=max_steps, mesh=mesh,
+                             repair_oracle=oracle),
                      donate_argnums=(0,) if donate else ())
         _fixpoint_cache[key] = fn
     return fn
@@ -1122,9 +1284,7 @@ def _frontier_bucket(num_active: int, num_brokers: int) -> Optional[int]:
     would do the same work with extra gathers."""
     if num_brokers <= _FRONTIER_DENSE_MIN:
         return None
-    bucket = _FRONTIER_DENSE_MIN
-    while bucket < num_active:
-        bucket *= 2
+    bucket = pow2_bucket(num_active, _FRONTIER_DENSE_MIN)
     if bucket >= num_brokers or 2 * num_active > num_brokers:
         return None
     return bucket
@@ -1177,10 +1337,11 @@ def _goal_fixpoint_budget(model: TensorClusterModel,
                           options: OptimizationOptions,
                           step_budget, frontier=None, *, spec=None,
                           prev_specs=(), constraint=None, num_sources=None,
-                          num_dests=None, mesh=None):
+                          num_dests=None, mesh=None, repair_oracle=False):
     """One CHUNK of a goal's fixpoint: identical math to _goal_fixpoint, but
     the step cap is a TRACED scalar and the packed stats come back as one
-    i32[5] vector (steps, actions, before, after, capped) — so every chunk
+    i32[8] vector (steps, actions, before, after, capped, repair_steps,
+    bisect_depth, lanes_live) — so every chunk
     length reuses ONE compiled executable per (goal, frontier bucket shape)
     and the driver's per-chunk fetch is a single transfer.  ``frontier`` is
     a traced FrontierInvariants (or None for dense): its compacted-axis
@@ -1193,25 +1354,30 @@ def _goal_fixpoint_budget(model: TensorClusterModel,
     inv = compute_step_invariants(spec, prev_specs, model, arrays0, constraint)
 
     def cond(state):
-        _, steps, _, last_n = state
+        _, steps, _, last_n, _rep, _dep, _lan = state
         return (last_n > 0) & (steps < step_budget)
 
     def body(state):
-        m, steps, total, _ = state
-        new_m, n = _goal_step(m, options, spec, prev_specs, constraint,
-                              num_sources, num_dests, mesh, invariants=inv,
-                              frontier=frontier)
+        m, steps, total, _, rep, dep, lan = state
+        new_m, n, sel = _goal_step(m, options, spec, prev_specs, constraint,
+                                   num_sources, num_dests, mesh,
+                                   invariants=inv, frontier=frontier,
+                                   repair_oracle=repair_oracle)
         n = n.astype(jnp.int32)
-        return (new_m, steps + 1, total + n, n)
+        return (new_m, steps + 1, total + n, n,
+                rep + sel[0], jnp.maximum(dep, sel[2]), lan + sel[1])
 
     init = (model, jnp.int32(0), jnp.int32(0),
-            jnp.where(skip, jnp.int32(0), jnp.int32(1)))
-    model, steps, total, last_n = jax.lax.while_loop(cond, body, init)
+            jnp.where(skip, jnp.int32(0), jnp.int32(1)),
+            jnp.int32(0), jnp.int32(0), jnp.int32(0))
+    (model, steps, total, last_n,
+     rep, dep, lan) = jax.lax.while_loop(cond, body, init)
     arrays1 = BrokerArrays.from_model(model)
     after = kernels.goal_satisfied(spec, model, arrays1, constraint)
     capped = (steps >= step_budget) & (last_n > 0)
     packed = jnp.stack([steps, total, before.astype(jnp.int32),
-                        after.astype(jnp.int32), capped.astype(jnp.int32)])
+                        after.astype(jnp.int32), capped.astype(jnp.int32),
+                        rep, dep, lan])
     return model, packed
 
 
@@ -1221,13 +1387,15 @@ _budget_cache: Dict[tuple, object] = {}
 def _get_budget_fixpoint_fn(spec: GoalSpec, prev_specs: Tuple[GoalSpec, ...],
                             constraint: BalancingConstraint, num_sources: int,
                             num_dests: int, mesh=None, donate: bool = False):
-    key = (spec, prev_specs, constraint, num_sources, num_dests, mesh, donate)
+    oracle = _repair_oracle()
+    key = (spec, prev_specs, constraint, num_sources, num_dests, mesh, donate,
+           oracle)
     fn = _budget_cache.get(key)
     if fn is None:
         fn = jax.jit(partial(_goal_fixpoint_budget, spec=spec,
                              prev_specs=prev_specs, constraint=constraint,
                              num_sources=num_sources, num_dests=num_dests,
-                             mesh=mesh),
+                             mesh=mesh, repair_oracle=oracle),
                      donate_argnums=(0,) if donate else ())
         _budget_cache[key] = fn
     return fn
@@ -1244,7 +1412,11 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
                       on_chunk=None):
     """Adaptive chunked driver for one goal's fixpoint.  Returns
     ``(model, info)`` where info = {chunks, buckets, fresh_compile, steps,
-    actions, satisfied_before, satisfied_after, capped}.
+    actions, satisfied_before, satisfied_after, capped, repair_steps,
+    bisect_depth, lanes_live} (the last three aggregate select_batched's
+    bounded-repair counters: steps whose repair passes saw a violation,
+    the max bisection depth compiled, and the summed live-lane counts at
+    compaction time).
 
     Per chunk boundary (band kinds with ``frontier`` on):
 
@@ -1278,6 +1450,9 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
     fresh = False
     steps_done = 0
     actions_total = 0
+    repair_total = 0
+    bisect_depth = 0
+    lanes_total = 0
     before0: Optional[bool] = None
     after = False
     capped = False
@@ -1311,6 +1486,10 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
         size0 = fn._cache_size() if hasattr(fn, "_cache_size") else None
         model, packed = fn(model, options, budget, fr)
         row = [int(x) for x in np.asarray(jax.device_get(packed))]
+        # A chunk that built (or deserialized) its executable this process
+        # carries that one-off wall in wall_s — flag it so the wall-slope
+        # flatness metric can exclude it (tools/tail_report.py).
+        chunk_fresh = size0 is not None and fn._cache_size() > size0
         if size0 is not None and fn._cache_size() > size0:
             # New trace for this (goal, bucket shape) — refine "fresh" the
             # same way the stack path does: a persistent-cache marker means
@@ -1323,15 +1502,20 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
             if token:
                 compile_cache.mark(token)
         wall = time.monotonic() - t0
-        s, a, b4, aft, cap = row
+        s, a, b4, aft, cap, rep, dep, lan = row
         if before0 is None:
             before0 = bool(b4)
         after = bool(aft)
         capped = bool(cap)
         steps_done += s
         actions_total += a
+        repair_total += rep
+        bisect_depth = max(bisect_depth, dep)
+        lanes_total += lan
         rec = {"steps": s, "actions": a, "wall_s": wall, "bucket": bucket,
-               "ns": cns, "nd": cnd}
+               "ns": cns, "nd": cnd, "repair_steps": rep,
+               "bisect_depth": dep, "lanes_live": lan,
+               "fresh_compile": chunk_fresh}
         chunks.append(rec)
         if on_chunk is not None:
             on_chunk(model, rec)
@@ -1355,7 +1539,9 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
             "fresh_compile": fresh, "steps": steps_done,
             "actions": actions_total,
             "satisfied_before": bool(before0) if before0 is not None else after,
-            "satisfied_after": after, "capped": capped}
+            "satisfied_after": after, "capped": capped,
+            "repair_steps": repair_total, "bisect_depth": bisect_depth,
+            "lanes_live": lanes_total}
     return model, info
 
 
@@ -1390,7 +1576,8 @@ def _get_sweep_fn(specs: Tuple[GoalSpec, ...],
 def _stack_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
                     specs: Tuple[GoalSpec, ...], constraint: BalancingConstraint,
                     num_sources: int, num_dests: int, max_steps: int, mesh=None,
-                    prev_specs: Tuple[GoalSpec, ...] = ()):
+                    prev_specs: Tuple[GoalSpec, ...] = (),
+                    repair_oracle: bool = False):
     """A run of goals in one XLA program: each goal's while_loop runs
     in priority order, prev-goal acceptance masks accumulating exactly as in
     the unfused path.  One dispatch + one host transfer for the whole run —
@@ -1398,28 +1585,47 @@ def _stack_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
     × dispatch + 6 scalar fetches each).  ``prev_specs`` seeds the
     already-optimized set, so a long stack can be split into a few chunked
     programs (the 200-broker single-program compile kernel-faults the TPU
-    worker; see optimize(fuse_group_size=...))."""
-    steps_l, actions_l, before_l, after_l, capped_l = [], [], [], [], []
+    worker; see optimize(fuse_group_size=...)).
+
+    Each goal runs through _goal_fixpoint_budget so the packed result is
+    one i32[8, G] matrix — (steps, actions, before, after, capped,
+    repair_steps, bisect_depth, lanes_live) per goal — and the grouped
+    path reports the bounded-repair counters just like the per-goal
+    frontier driver does."""
+    packed_l = []
     prev: Tuple[GoalSpec, ...] = tuple(prev_specs)
     for spec in specs:
-        model, steps, total, before, after, capped = _goal_fixpoint(
-            model, options, spec, prev, constraint, num_sources, num_dests,
-            max_steps, mesh)
-        steps_l.append(steps)
-        actions_l.append(total)
-        before_l.append(before)
-        after_l.append(after)
-        capped_l.append(capped)
+        model, packed = _goal_fixpoint_budget(
+            model, options, jnp.int32(max_steps), None, spec=spec,
+            prev_specs=prev, constraint=constraint,
+            num_sources=num_sources, num_dests=num_dests, mesh=mesh,
+            repair_oracle=repair_oracle)
+        packed_l.append(packed)
         prev = prev + (spec,)
-    # One i32[5, G] result matrix: a single host fetch covers the whole run
-    # (each device_get round trip costs ~0.5-1 s over a tunneled TPU; five
-    # separate vectors were five round trips).
-    packed = jnp.stack([
-        jnp.stack(steps_l), jnp.stack(actions_l),
-        jnp.stack(before_l).astype(jnp.int32),
-        jnp.stack(after_l).astype(jnp.int32),
-        jnp.stack(capped_l).astype(jnp.int32)])
-    return model, packed
+    # One i32[8, G] result matrix: a single host fetch covers the whole run
+    # (each device_get round trip costs ~0.5-1 s over a tunneled TPU;
+    # separate vectors were separate round trips).
+    return model, jnp.stack(packed_l, axis=1)
+
+
+def _push_repair_sensors(goal_name: str, repair_steps: int,
+                         bisect_depth: int, lanes_live: int) -> None:
+    """Bounded-repair counters into the sensor registry — both fused paths
+    (per-goal frontier driver and grouped stack programs) report through
+    here so /metrics carries the repair families regardless of grouping."""
+    labels = {"goal": goal_name}
+    SENSORS.counter(
+        "GoalOptimizer.repair-steps", labels=labels,
+        help="Steps whose bounded selection repair saw a violation",
+    ).inc(repair_steps)
+    SENSORS.counter(
+        "GoalOptimizer.repair-lanes-live", labels=labels,
+        help="Live candidate lanes at compaction, summed over steps",
+    ).inc(lanes_live)
+    SENSORS.gauge(
+        "GoalOptimizer.repair-bisect-depth", labels=labels,
+        help="Compiled repair bisection depth (log2 of lane count)",
+    ).set(bisect_depth)
 
 
 _stack_cache: Dict[tuple, object] = {}
@@ -1428,14 +1634,15 @@ _stack_cache: Dict[tuple, object] = {}
 def _get_stack_fn(specs: Tuple[GoalSpec, ...], constraint: BalancingConstraint,
                   num_sources: int, num_dests: int, max_steps: int, mesh=None,
                   prev_specs: Tuple[GoalSpec, ...] = (), donate: bool = False):
+    oracle = _repair_oracle()
     key = (specs, constraint, num_sources, num_dests, max_steps, mesh,
-           prev_specs, donate)
+           prev_specs, donate, oracle)
     fn = _stack_cache.get(key)
     if fn is None:
         fn = jax.jit(partial(_stack_fixpoint, specs=specs, constraint=constraint,
                              num_sources=num_sources, num_dests=num_dests,
                              max_steps=max_steps, mesh=mesh,
-                             prev_specs=prev_specs),
+                             prev_specs=prev_specs, repair_oracle=oracle),
                      donate_argnums=(0,) if donate else ())
         _stack_cache[key] = fn
     return fn
@@ -1464,9 +1671,18 @@ class GoalResult:
     # goal in a freshly-built chunk program reports True.
     fresh_compile: bool = False
     # Per-chunk records from the frontier driver (steps, actions, wall_s,
-    # bucket, ns, nd) when the goal ran through frontier_fixpoint; None on
-    # the legacy paths.  tools/tail_report.py summarizes these.
+    # bucket, ns, nd, repair_steps, bisect_depth, lanes_live) when the goal
+    # ran through frontier_fixpoint; None on the legacy paths.
+    # tools/tail_report.py summarizes these.
     chunks: Optional[list] = None
+    # Bounded-repair observability (both fused paths — the per-goal
+    # frontier driver and the grouped stack programs; zeros on the legacy
+    # unfused path): how many steps fired a repair pass, the compiled
+    # bisection depth, and the summed live-lane counts seen by the
+    # candidate compaction.
+    repair_steps: int = 0
+    bisect_depth: int = 0
+    lanes_live: int = 0
 
 
 @dataclasses.dataclass
@@ -1547,7 +1763,10 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
             TRACE.record("analyzer.goal", g.duration_s, goal=g.name,
                          steps=g.steps, actions=g.actions_applied,
                          satisfied_after=g.satisfied_after, capped=g.capped,
-                         fresh_compile=g.fresh_compile)
+                         fresh_compile=g.fresh_compile,
+                         repair_steps=g.repair_steps,
+                         bisect_depth=g.bisect_depth,
+                         lanes_live=g.lanes_live)
         sp.annotate(actions=sum(g.actions_applied for g in run.goal_results),
                     steps=sum(g.steps for g in run.goal_results),
                     candidates_scored=run.num_candidates_scored)
@@ -1763,7 +1982,14 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
                     duration_s=time.monotonic() - tg,
                     capped=info["capped"],
                     fresh_compile=info["fresh_compile"],
-                    chunks=info["chunks"]))
+                    chunks=info["chunks"],
+                    repair_steps=info.get("repair_steps", 0),
+                    bisect_depth=info.get("bisect_depth", 0),
+                    lanes_live=info.get("lanes_live", 0)))
+                _push_repair_sensors(spec.name,
+                                     info.get("repair_steps", 0),
+                                     info.get("bisect_depth", 0),
+                                     info.get("lanes_live", 0))
                 if spec.is_hard and not info["satisfied_after"] \
                         and raise_on_hard_failure:
                     raise OptimizationFailureException(
@@ -1819,9 +2045,10 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
                         model.partition_valid):
                 if hasattr(arr, "copy_to_host_async"):
                     arr.copy_to_host_async()
-            steps_v, actions_v, before_v, after_v, capped_v = (
+            (steps_v, actions_v, before_v, after_v, capped_v,
+             repair_v, depth_v, lanes_v) = (
                 np.concatenate([row[i] for row in packed_rows])
-                for i in range(5))
+                for i in range(8))
             for i, spec in enumerate(specs):
                 scored += int(steps_v[i]) * k_of(spec)
                 results.append(GoalResult(
@@ -1830,7 +2057,12 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
                     satisfied_after=bool(after_v[i]),
                     steps=int(steps_v[i]), actions_applied=int(actions_v[i]),
                     duration_s=durations[i], capped=bool(capped_v[i]),
-                    fresh_compile=fresh_v[i]))
+                    fresh_compile=fresh_v[i],
+                    repair_steps=int(repair_v[i]),
+                    bisect_depth=int(depth_v[i]),
+                    lanes_live=int(lanes_v[i])))
+                _push_repair_sensors(spec.name, int(repair_v[i]),
+                                     int(depth_v[i]), int(lanes_v[i]))
                 if spec.is_hard and not bool(after_v[i]) \
                         and raise_on_hard_failure:
                     raise OptimizationFailureException(

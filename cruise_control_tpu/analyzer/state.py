@@ -71,6 +71,20 @@ class StepInvariants:
     designated: Optional[Array] = None  # bool[T] when min-leaders is in play
 
 
+def pow2_bucket(n: int, floor: int) -> int:
+    """Smallest power-of-two-of-``floor`` bucket ≥ ``n`` (doubling ladder
+    starting at ``floor``).  The shared bucketing rule of every compacted
+    axis in the analyzer: the frontier's broker axis (FrontierInvariants)
+    and the live-candidate lane axis (optimizer select_batched compaction)
+    both quantize to this ladder, so at most ~log2(size/floor) distinct
+    compiled shapes exist per goal for each axis."""
+    bucket = max(1, int(floor))
+    n = max(1, int(n))
+    while bucket < n:
+        bucket *= 2
+    return bucket
+
+
 @struct.dataclass
 class FrontierInvariants:
     """The *active frontier* of one goal's chunked fixpoint: the brokers that

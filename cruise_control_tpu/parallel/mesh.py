@@ -56,9 +56,12 @@ def make_sharded_step(spec: GoalSpec, prev_specs: Tuple[GoalSpec, ...],
                       constraint: BalancingConstraint, num_sources: int,
                       num_dests: int, mesh: Mesh):
     """Jitted optimizer step with mesh-sharded candidate scoring.  Cached on
-    (spec, prev_specs, constraint, widths, mesh) like the single-device
-    step.  Input arrays keep whatever placement the caller chose (replicated
-    model, or replica-axis-sharded via ``shard_model_replica_axis``)."""
+    (spec, prev_specs, constraint, widths, mesh, repair-oracle flag) like
+    the single-device step, and like it returns ``(model, num_applied,
+    sel_stats)`` — the bounded-repair counters ride the same GSPMD program
+    (scalar reductions; XLA places the psums).  Input arrays keep whatever
+    placement the caller chose (replicated model, or replica-axis-sharded
+    via ``shard_model_replica_axis``)."""
     return _get_step_fn(spec, prev_specs, constraint, num_sources, num_dests,
                         mesh=mesh)
 

@@ -233,7 +233,7 @@ def test_band_budgets_subsume_band_accepts():
         m = fix(m, options)[0]
     g = goals_by_priority(["ReplicaDistributionGoal"])[0]
     step = opt._get_step_fn(g, prev, con, ns, nd)
-    new_m, n = step(m, options)
+    new_m, n, _ = step(m, options)
     assert int(n) > 0
 
     rb0 = np.asarray(m.replica_broker)
@@ -287,7 +287,7 @@ def test_band_budgets_subsume_with_hard_dist_goal():
         m = fix(m, options)[0]
     g = goals_by_priority(["ReplicaDistributionGoal"])[0]
     step = opt._get_step_fn(g, prev, con, ns, nd)
-    new_m, n = step(m, options)
+    new_m, n, _ = step(m, options)
     assert int(n) > 0
 
     rb0 = np.asarray(m.replica_broker)

@@ -37,10 +37,10 @@ def test_sharded_step_matches_unsharded(model):
     ns, nd = 32, 8
 
     step = opt._get_step_fn(spec, (), con, ns, nd)
-    ref_model, ref_n = step(model, options)
+    ref_model, ref_n, _ = step(model, options)
 
     sharded = pmesh.make_sharded_step(spec, (), con, ns, nd, mesh)
-    got_model, got_n = sharded(model, options)
+    got_model, got_n, _ = sharded(model, options)
 
     assert int(ref_n) == int(got_n)
     np.testing.assert_array_equal(np.asarray(ref_model.replica_broker),

@@ -78,13 +78,13 @@ print(json.dumps({"probe": "segmented_fixpoint",
                   "ok": all(g.satisfied_after for g in run.goal_results)}),
       flush=True)
 
-# Probe 4: packed transfer (one i32[5, G] fetch for a whole stack run).
+# Probe 4: packed transfer (one i32[8, G] fetch for a whole stack run).
 stack = tuple(goals_by_priority(["RackAwareGoal", "ReplicaDistributionGoal"]))
 stack_fn = opt._get_stack_fn(stack, constraint, 64, 8, 64)
 m2, packed = stack_fn(model, options)
 packed_host = jax.device_get(packed)
 print(json.dumps({"probe": "packed_transfer",
-                  "ok": packed_host.shape == (5, 2)}), flush=True)
+                  "ok": packed_host.shape == (8, 2)}), flush=True)
 
 # Probe 5: full small-stack optimize end to end on the chip.
 from bench import STACK

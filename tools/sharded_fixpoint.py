@@ -174,7 +174,10 @@ def main():
                                    "actions": rec["actions"],
                                    "wall_s": round(rec["wall_s"], 1),
                                    "bucket": rec["bucket"],
-                                   "ns": rec["ns"], "nd": rec["nd"]})
+                                   "ns": rec["ns"], "nd": rec["nd"],
+                                   "repair_steps": rec.get("repair_steps", 0),
+                                   "bisect_depth": rec.get("bisect_depth", 0),
+                                   "lanes_live": rec.get("lanes_live", 0)})
                     progress["current"] = {
                         "name": name, "chunks": chunks,
                         "satisfied_before": before0,

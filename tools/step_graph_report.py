@@ -73,6 +73,54 @@ def _as_jaxpr(v):
     return None
 
 
+def count_primitive(jaxpr, name: str) -> int:
+    """Recursive count of equations whose primitive is ``name`` (sub-jaxprs
+    of while/cond/scan/pjit included).  The bounded-repair acceptance bar is
+    ``count_primitive(body, "while") == 0`` — no data-dependent trip count
+    anywhere inside the per-step graph."""
+    total = 0
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == name:
+            total += 1
+        for v in eqn.params.values():
+            total += _count_prim_param(v, name)
+    return total
+
+
+def _count_prim_param(v, name: str) -> int:
+    inner = _as_jaxpr(v)
+    if inner is not None:
+        return count_primitive(inner, name)
+    if isinstance(v, (list, tuple)):
+        return sum(_count_prim_param(x, name) for x in v)
+    return 0
+
+
+def subgraph_equations(jaxpr, name: str) -> int:
+    """Total equations inside sub-jaxprs of ``name`` primitives (recursive).
+    With ``name="scan"`` on the fixpoint body this measures the bounded
+    repair's bisection subgraph — the scans are the only fixed-trip loops in
+    the step — so the report can attribute repair cost separately."""
+    total = 0
+    for eqn in jaxpr.eqns:
+        for v in eqn.params.values():
+            inner = _as_jaxpr(v)
+            if inner is not None:
+                if eqn.primitive.name == name:
+                    total += count_equations(inner)
+                else:
+                    total += subgraph_equations(inner, name)
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    ij = _as_jaxpr(x)
+                    if ij is not None:
+                        if eqn.primitive.name == name:
+                            total += count_equations(ij)
+                        else:
+                            total += subgraph_equations(ij, name)
+    return total
+
+
 def _find_while_body(jaxpr):
     """The fixpoint's top-level while_loop body sub-jaxpr."""
     for eqn in jaxpr.eqns:
@@ -142,6 +190,13 @@ def report(goal: str = "ReplicaDistributionGoal",
         "outer_equations": fix_eqns - body_eqns,
         "fixpoint_equations": fix_eqns,
         "step_equations": step_eqns,
+        # Bounded-repair accounting: the bisection scans are the only
+        # fixed-trip loops inside the body, so their sub-jaxpr equations
+        # are the repair subgraph; while/cond counts pin the "no
+        # data-dependent trip count / no branch divergence" invariant.
+        "repair_scan_equations": subgraph_equations(body, "scan"),
+        "body_while_primitives": count_primitive(body, "while"),
+        "body_cond_primitives": count_primitive(body, "cond"),
     }
 
 
@@ -249,6 +304,9 @@ def main() -> None:
     print(f"  outside-loop equations    : {rec['outer_equations']}")
     print(f"  fixpoint total            : {rec['fixpoint_equations']}")
     print(f"  standalone step total     : {rec['step_equations']}")
+    print(f"  repair (scan) equations   : {rec['repair_scan_equations']}")
+    print(f"  body while primitives     : {rec['body_while_primitives']}")
+    print(f"  body cond primitives      : {rec['body_cond_primitives']}")
 
 
 if __name__ == "__main__":

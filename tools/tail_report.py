@@ -15,6 +15,12 @@ of the goal's time spent admitting almost nothing, i.e. the fraction the
 frontier path can reclaim.  Records without per-chunk data (bench.py
 per_goal entries) still report totals with ``tail_fraction: null``.
 
+The report also derives each goal's **wall slope** — max/min per-step wall
+over chunks of the same compiled shape (bucket, ns, nd) — the flatness
+signature of the bounded-depth repair: with a fixed-trip step graph the
+per-step wall should not depend on how close the state sits to a band
+edge (see ``wall_slope``).
+
 Usage:
     python tools/tail_report.py SHARDED_1M_r05.json [--tail-frac 0.1] [--json]
 """
@@ -44,6 +50,30 @@ def _chunk_tail(chunks: list, tail_frac: float) -> dict:
     }
 
 
+def wall_slope(chunks: list) -> Optional[float]:
+    """max/min per-step wall over same-shape chunks — the flatness metric
+    of the bounded repair.  Chunks are grouped by their compiled shape
+    ``(bucket, ns, nd)`` (different shapes are different executables and
+    legitimately cost differently); within a group every step runs the SAME
+    fixed-depth program, so the per-step wall should be flat.  A slope much
+    above 1 means data-dependent work crept back into the step (the legacy
+    drop loop's signature: band-edge chunks ~2.7× over mid-run chunks).
+    Chunks flagged ``fresh_compile`` carry their executable's build wall
+    and are excluded.  None when no shape group has two measurable
+    chunks."""
+    groups: dict = {}
+    for c in chunks:
+        steps = int(c.get("steps", 0))
+        wall = float(c.get("wall_s", 0.0))
+        if steps <= 0 or wall <= 0.0 or c.get("fresh_compile"):
+            continue
+        key = (c.get("bucket"), c.get("ns"), c.get("nd"))
+        groups.setdefault(key, []).append(wall / steps)
+    slopes = [max(per) / min(per) for per in groups.values()
+              if len(per) >= 2 and min(per) > 0]
+    return round(max(slopes), 3) if slopes else None
+
+
 def goal_summary(name: str, g: dict, tail_frac: float) -> dict:
     chunks = g.get("chunks")
     rec = {
@@ -54,10 +84,14 @@ def goal_summary(name: str, g: dict, tail_frac: float) -> dict:
     }
     if chunks:
         rec.update(_chunk_tail(chunks, tail_frac))
+        rec["wall_slope"] = wall_slope(chunks)
+        rec["repair_steps"] = sum(int(c.get("repair_steps", 0))
+                                  for c in chunks)
     else:
         rec.update({"num_chunks": 0, "peak_actions_per_step": None,
                     "tail_chunks": 0, "tail_wall_s": 0.0,
-                    "tail_fraction": None})
+                    "tail_fraction": None, "wall_slope": None,
+                    "repair_steps": g.get("repair_steps", 0)})
     return rec
 
 
@@ -70,6 +104,7 @@ def tail_summary(record: dict, tail_frac: float = 0.1) -> dict:
     with_chunks = [g for g in goals if g["tail_fraction"] is not None]
     total_wall = sum(g["wall_s"] for g in with_chunks)
     tail_wall = sum(g["tail_wall_s"] for g in with_chunks)
+    slopes = [g["wall_slope"] for g in goals if g.get("wall_slope")]
     return {
         "metric": record.get("metric"),
         "tail_frac_threshold": tail_frac,
@@ -78,6 +113,7 @@ def tail_summary(record: dict, tail_frac: float = 0.1) -> dict:
         "tail_wall_s": round(tail_wall, 1),
         "tail_fraction": (round(tail_wall / total_wall, 3)
                           if total_wall > 0 else None),
+        "wall_slope": max(slopes) if slopes else None,
     }
 
 
@@ -95,18 +131,22 @@ def main(argv: Optional[list] = None) -> None:
         print(json.dumps(rep), flush=True)
         return
     print(f"{'goal':<40} {'steps':>6} {'actions':>8} {'wall_s':>8} "
-          f"{'chunks':>6} {'tail_s':>8} {'tail%':>6}")
+          f"{'chunks':>6} {'tail_s':>8} {'tail%':>6} {'slope':>6}")
     for g in rep["goals"]:
         tf = (f"{100 * g['tail_fraction']:.0f}%"
               if g["tail_fraction"] is not None else "-")
+        sl = (f"{g['wall_slope']:.2f}"
+              if g.get("wall_slope") is not None else "-")
         print(f"{g['goal']:<40} {g['steps']:>6} {g['actions']:>8} "
               f"{g['wall_s']:>8.1f} {g['num_chunks']:>6} "
-              f"{g['tail_wall_s']:>8.1f} {tf:>6}")
+              f"{g['tail_wall_s']:>8.1f} {tf:>6} {sl:>6}")
     tf = (f"{100 * rep['tail_fraction']:.0f}%"
           if rep["tail_fraction"] is not None else "-")
+    sl = (f"{rep['wall_slope']:.2f}"
+          if rep.get("wall_slope") is not None else "-")
     print(f"{'TOTAL (goals with chunk data)':<40} {'':>6} {'':>8} "
           f"{rep['total_wall_s']:>8.1f} {'':>6} {rep['tail_wall_s']:>8.1f} "
-          f"{tf:>6}")
+          f"{tf:>6} {sl:>6}")
 
 
 if __name__ == "__main__":
