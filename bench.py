@@ -39,6 +39,12 @@ mid-compile — round-3's capture died this way):
   ``BENCH_PARTIAL.jsonl``), so an outer SIGKILL at 600 s can no longer
   produce rc=124 with parsed:null: the bench always beats the harness to
   the exit.  Per-phase deadlines are clamped to the remaining total.
+- SIGTERM and SIGALRM (what ``timeout`` and alarm-based harnesses send
+  before escalating to SIGKILL) flush the same final line: a kill signal
+  lands mid-rung, the completed rungs still reach stdout and the process
+  exits 0 (3 only when NOTHING completed — still one parseable line).
+  ``BENCH_SELFTEST_WEDGE=1`` is the regression hook: record one synthetic
+  rung, then wedge until a signal arrives (tests/test_frontier.py).
 """
 
 from __future__ import annotations
@@ -46,6 +52,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import sys
 import threading
 import time
@@ -75,8 +82,9 @@ STACK = [
 ]
 
 _completed: list = []  # rung records finished so far (read by the watchdog)
-_PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             "BENCH_PARTIAL.jsonl")
+_PARTIAL_PATH = (os.environ.get("BENCH_PARTIAL_PATH")
+                 or os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "BENCH_PARTIAL.jsonl"))
 
 
 def _emit_and_exit(payload: dict, rc: int) -> None:
@@ -153,6 +161,20 @@ def _watchdog(seconds: float, phase: str, retry_exec: bool = False):
     return t.cancel
 
 
+def _install_kill_handlers() -> None:
+    """SIGTERM/SIGALRM → flush the final JSON line and exit.  ``timeout``
+    sends TERM seconds before its KILL escalation; catching it turns the
+    rc=124/parsed:null failure mode into a parseable line with every
+    completed rung (rc 0 when at least one rung made it, 3 otherwise)."""
+    def fire(signum, frame):
+        _emit_final(3, error=f"killed_by_signal_{signum}")
+    for sig in (signal.SIGTERM, signal.SIGALRM):
+        try:
+            signal.signal(sig, fire)
+        except (ValueError, OSError):
+            pass  # non-main thread / exotic platform: watchdogs still cover
+
+
 def _record_rung(rec: dict) -> None:
     _completed.append(rec)
     sys.stderr.write(json.dumps(rec) + "\n")
@@ -195,6 +217,7 @@ def run_rung(scale: str, max_candidates, fast: bool) -> dict:
                  fused=True, max_candidates_per_step=max_candidates,
                  fast_mode=fast, donate_model=True)
 
+    disp0 = dict(opt.FETCH_COUNTERS)
     t0 = time.monotonic()
     run = opt.optimize(opt.donation_copy(model), STACK,
                        raise_on_hard_failure=False, fused=True,
@@ -202,6 +225,7 @@ def run_rung(scale: str, max_candidates, fast: bool) -> dict:
                        donate_model=True)
     proposals = props.diff(model, run.model)
     wall_s = time.monotonic() - t0
+    dispatch = {k: opt.FETCH_COUNTERS[k] - disp0[k] for k in disp0}
 
     hard_ok = all(g.satisfied_after for g in run.goal_results if g.is_hard)
     plans_per_s = run.num_candidates_scored / max(wall_s, 1e-9)
@@ -218,6 +242,13 @@ def run_rung(scale: str, max_candidates, fast: bool) -> dict:
         "num_proposals": len(proposals),
         "hard_goals_satisfied": hard_ok,
         "candidates_scored": run.num_candidates_scored,
+        # Round-trip accounting for the timed pass: blocking host fetches
+        # and the speculative-dispatch economy (tools/dispatch_report.py
+        # renders these; a fetch count above the chunk count means a probe
+        # crept back into the boundary path).
+        "dispatch": dispatch,
+        "fetch_wait_s": round(sum(g.fetch_wait_s for g in run.goal_results),
+                              3),
         # Per-goal steps/actions/wall/capped so a step-count regression in
         # one goal is visible round-over-round (the reference records
         # per-goal durations in every OptimizerResult,
@@ -227,7 +258,10 @@ def run_rung(scale: str, max_candidates, fast: bool) -> dict:
             "wall_s": round(g.duration_s, 3), "capped": g.capped,
             "satisfied_after": g.satisfied_after,
             "repair_steps": g.repair_steps, "bisect_depth": g.bisect_depth,
-            "lanes_live": g.lanes_live,
+            "lanes_live": g.lanes_live, "fetches": g.fetches,
+            "fetch_wait_s": round(g.fetch_wait_s, 3),
+            "chunks_speculative": g.chunks_speculative,
+            "chunks_wasted": g.chunks_wasted,
             **({"chunks": g.chunks} if g.chunks else {}),
         } for g in run.goal_results},
         **({"fast_mode": True} if fast else {}),
@@ -296,6 +330,11 @@ def main() -> None:
                         "error": f"invalid rung selection {scale_sel!r}"}, 2)
     max_candidates = int(os.environ.get("BENCH_MAX_CANDIDATES", "0")) or None
     fast = bool(int(os.environ.get("BENCH_FAST", "0")))
+    _install_kill_handlers()
+    # The candidate-width compile ceiling is opt-in now
+    # (CRUISE_TPU_COMPILE_CEILING, default off); the bench keeps the
+    # tunneled-TPU hang protection the ceiling was introduced for.
+    os.environ.setdefault("CRUISE_TPU_COMPILE_CEILING", "auto")
     if os.environ.get("BENCH_RETRY") != "1":
         # Fresh run: drop stale partial records so recovered results can't
         # mix runs (the re-exec retry keeps the same run's file).
@@ -310,6 +349,17 @@ def main() -> None:
     # Backstop for any gap the phase watchdogs don't cover: the TOTAL
     # deadline always gets the final JSON line out before the harness kill.
     _watchdog(_budget_remaining(), "total_budget_exhausted")
+
+    if os.environ.get("BENCH_SELFTEST_WEDGE") == "1":
+        # Regression hook for the kill-signal path: record one synthetic
+        # rung, then wedge like a hung backend until the harness' TERM (or
+        # the total-budget watchdog) arrives.  Exercised by the suite; never
+        # set in real runs.
+        _record_rung({"metric": "wall_clock_to_goal_satisfying_proposal_small",
+                      "value": 0.0, "unit": "s", "vs_baseline": 0.0,
+                      "selftest": True})
+        while True:
+            signal.pause()
 
     # Phase 1: backend init under a deadline, one re-exec retry.
     cancel = _watchdog(init_timeout, "backend_unavailable", retry_exec=True)

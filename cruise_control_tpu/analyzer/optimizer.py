@@ -50,7 +50,8 @@ from cruise_control_tpu.analyzer.actions import Candidates, apply_candidates
 from cruise_control_tpu.analyzer.balancing_constraint import BalancingConstraint
 from cruise_control_tpu.analyzer.goals import kernels
 from cruise_control_tpu.analyzer.goals.specs import GoalSpec, goals_by_priority
-from cruise_control_tpu.analyzer.state import (BrokerArrays, FrontierInvariants,
+from cruise_control_tpu.analyzer.state import (PACKED_CAPPED, BrokerArrays,
+                                               FrontierInvariants,
                                                OptimizationOptions,
                                                StepInvariants, pow2_bucket)
 from cruise_control_tpu.common import compile_cache
@@ -902,10 +903,12 @@ def compute_step_invariants(spec: GoalSpec, prev_specs: Tuple[GoalSpec, ...],
 # The tunneled TPU's remote-compile service hangs on S×D cross batches
 # beyond roughly this many candidates (probed round 5: 256k-wide programs
 # at 1000 brokers hung for two rounds; the same shapes compile and run
-# once capped — BASELINE.md).  The ceiling binds ONLY on the tpu backend:
-# CPU / virtual-mesh runs compile 1M-shape programs in seconds and need
-# the wide dest sets (nd=16 at 7k brokers starves the usage-distribution
-# goals' exploration).
+# once capped — BASELINE.md).  That is a deployment property of ONE
+# backend transport, not of the analyzer, so the ceiling is opt-in
+# (config/env), not inferred from backend detection: CPU / virtual-mesh
+# runs compile 1M-shape programs in seconds and need the wide dest sets
+# (nd=16 at 7k brokers starves the usage-distribution goals' exploration),
+# and a local (untunneled) TPU does not share the remote-compile hang.
 _COMPILE_CEILING_K = 32_768
 
 
@@ -913,22 +916,28 @@ def _cross_ceiling_k() -> Optional[int]:
     """The active candidate-batch compile ceiling, or None when unlimited.
 
     Gated by CRUISE_TPU_COMPILE_CEILING (env, or the
-    analyzer.tpu.compile.ceiling config key propagated to it by app.py):
-    unset / "auto" keeps the historical behavior — the ceiling binds only
-    when the tpu backend is active (the tunneled dev backend's
-    remote-compile service is what hangs on wide programs); "0" / "off" /
-    "none" disables it everywhere; a positive integer imposes that ceiling
-    on ANY backend (useful to reproduce TPU-shaped batches on CPU).
+    analyzer.tpu.compile.ceiling config key propagated to it by app.py).
+    Unset / "off" / "0" / "none" disables it everywhere — the DEFAULT:
+    backend detection used to impose the ceiling on any tpu backend, which
+    silently narrowed candidate batches on healthy local TPUs.  "auto"
+    opts back into the historical behavior — the ceiling binds only when
+    the tpu backend is active (deployments on the tunneled dev backend,
+    whose remote-compile service is what hangs on wide programs, set this;
+    bench.py does).  A positive integer imposes that ceiling on ANY
+    backend (useful to reproduce TPU-shaped batches on CPU).  Every clamp
+    the active ceiling causes is counted by the
+    ``GoalOptimizer.compile-ceiling-clamps`` sensor and logged.
     """
-    raw = os.environ.get("CRUISE_TPU_COMPILE_CEILING", "auto").strip().lower()
-    if raw in ("0", "off", "none", "false"):
+    raw = os.environ.get("CRUISE_TPU_COMPILE_CEILING", "off").strip().lower()
+    if raw in ("", "0", "off", "none", "false"):
         return None
-    if raw not in ("", "auto"):
+    if raw != "auto":
         try:
             return max(1, int(raw))
         except ValueError:
             _LOG.warning("ignoring non-integer CRUISE_TPU_COMPILE_CEILING=%r",
                          raw)
+            return None
     try:
         return _COMPILE_CEILING_K if jax.default_backend() == "tpu" else None
     except Exception:  # noqa: BLE001 — backend probing must never fail a run
@@ -1312,25 +1321,29 @@ def _build_frontier(active_np: np.ndarray, bucket: int) -> FrontierInvariants:
                               full_of_compact=jnp.asarray(full_of_compact))
 
 
-_frontier_mask_cache: Dict[tuple, object] = {}
+# Dispatch/fetch accounting of the async chunk drivers (this module's
+# frontier_fixpoint and the grouped-stack pipeline).  Process-global like
+# SWEEP_COUNTERS; the fetch-count budget test and tools/dispatch_report.py
+# read these, and every entry also lands in the per-goal sensor families
+# (GoalOptimizer.device-fetches / chunks-speculative / chunks-wasted).
+FETCH_COUNTERS = {"device_fetches": 0, "chunks_dispatched": 0,
+                  "chunks_speculative": 0, "chunks_wasted": 0}
+
+_gate_fn = None
 
 
-def _get_frontier_mask_fn(spec: GoalSpec, constraint: BalancingConstraint):
-    """Jitted (model) -> (active bool[B], num_active, satisfied, any_offline)
-    — the one small dispatch the chunk driver runs at each chunk boundary."""
-    key = (spec, constraint)
-    fn = _frontier_mask_cache.get(key)
-    if fn is None:
-        def mask_fn(model):
-            arrays = BrokerArrays.from_model(model)
-            active = kernels.frontier_active(spec, model, arrays, constraint)
-            satisfied = kernels.goal_satisfied(spec, model, arrays, constraint)
-            any_offline = (model.replica_offline_now() &
-                           model.replica_valid).any()
-            return active, active.sum(), satisfied, any_offline
-        fn = jax.jit(mask_fn)
-        _frontier_mask_cache[key] = fn
-    return fn
+def _get_gate_fn():
+    """Jitted ``(packed, budget) -> packed[PACKED_CAPPED] * budget`` — the
+    on-device budget gate of speculative dispatch.  The follow-up chunk's
+    step budget is the predecessor's capped flag times the host's optimistic
+    chunk length, computed WITHOUT fetching the flag: if the predecessor
+    converged the product is 0 and the follow-up is a no-op by construction.
+    One tiny executable shared by every goal (packed layout is uniform)."""
+    global _gate_fn
+    if _gate_fn is None:
+        _gate_fn = jax.jit(
+            lambda packed, budget: packed[PACKED_CAPPED] * budget)
+    return _gate_fn
 
 
 def _goal_fixpoint_budget(model: TensorClusterModel,
@@ -1340,13 +1353,26 @@ def _goal_fixpoint_budget(model: TensorClusterModel,
                           num_dests=None, mesh=None, repair_oracle=False):
     """One CHUNK of a goal's fixpoint: identical math to _goal_fixpoint, but
     the step cap is a TRACED scalar and the packed stats come back as one
-    i32[8] vector (steps, actions, before, after, capped, repair_steps,
-    bisect_depth, lanes_live) — so every chunk
-    length reuses ONE compiled executable per (goal, frontier bucket shape)
-    and the driver's per-chunk fetch is a single transfer.  ``frontier`` is
-    a traced FrontierInvariants (or None for dense): its compacted-axis
-    SHAPE specializes the trace, its values don't — all chunks of one
-    bucket share an executable."""
+    i32[PACKED_WIDTH] vector (see state.py for the slot layout) — so every
+    chunk length reuses ONE compiled executable per (goal, frontier bucket
+    shape) and the driver's per-chunk fetch is a single transfer.
+
+    Returns ``(model, packed, active)``.  The chunk carries EVERY
+    chunk-boundary decision input in its own outputs — exit-state
+    satisfaction and offline flags, convergence/capped state, and (band
+    kinds) the post-chunk frontier mask with its population — so the driver
+    never dispatches a separate boundary probe: one fetch of
+    ``(packed, active)`` answers "exit? rebucket? keep going?".  For
+    non-band specs ``active`` is a constant all-False mask and
+    ``num_active`` is -1.
+
+    ``frontier`` is a traced FrontierInvariants (or None for dense): its
+    compacted-axis SHAPE specializes the trace, its values don't — all
+    chunks of one bucket share an executable.  A ``step_budget`` of zero
+    skips the loop entirely (the while condition is false before the first
+    step), which is what makes speculative dispatch free to discard: a
+    follow-up chunk whose on-device budget gate collapsed to 0 returns the
+    model bit-unchanged."""
     arrays0 = BrokerArrays.from_model(model)
     before = kernels.goal_satisfied(spec, model, arrays0, constraint)
     any_offline = (model.replica_offline_now() & model.replica_valid).any()
@@ -1374,11 +1400,19 @@ def _goal_fixpoint_budget(model: TensorClusterModel,
      rep, dep, lan) = jax.lax.while_loop(cond, body, init)
     arrays1 = BrokerArrays.from_model(model)
     after = kernels.goal_satisfied(spec, model, arrays1, constraint)
+    off_after = (model.replica_offline_now() & model.replica_valid).any()
     capped = (steps >= step_budget) & (last_n > 0)
+    if spec is not None and kernels.is_band_kind(spec):
+        active = kernels.frontier_active(spec, model, arrays1, constraint)
+        num_active = active.sum().astype(jnp.int32)
+    else:
+        active = jnp.zeros((model.num_brokers,), dtype=bool)
+        num_active = jnp.int32(-1)
     packed = jnp.stack([steps, total, before.astype(jnp.int32),
                         after.astype(jnp.int32), capped.astype(jnp.int32),
-                        rep, dep, lan])
-    return model, packed
+                        rep, dep, lan, num_active,
+                        off_after.astype(jnp.int32)])
+    return model, packed, active
 
 
 _budget_cache: Dict[tuple, object] = {}
@@ -1409,42 +1443,61 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
                       max_steps: int = 256, chunk_steps: int = 32,
                       mesh=None, donate: bool = False, frontier: bool = True,
                       tail_threshold: float = 0.1, min_chunk: int = 4,
-                      on_chunk=None):
-    """Adaptive chunked driver for one goal's fixpoint.  Returns
+                      on_chunk=None, speculate: Optional[bool] = None):
+    """Async chunked driver for one goal's fixpoint.  Returns
     ``(model, info)`` where info = {chunks, buckets, fresh_compile, steps,
     actions, satisfied_before, satisfied_after, capped, repair_steps,
-    bisect_depth, lanes_live} (the last three aggregate select_batched's
-    bounded-repair counters: steps whose repair passes saw a violation,
-    the max bisection depth compiled, and the summed live-lane counts at
-    compaction time).
+    bisect_depth, lanes_live, fetches, fetch_wait_s, chunks_speculative,
+    chunks_wasted}.
 
-    Per chunk boundary (band kinds with ``frontier`` on):
+    The chunk boundary is round-trip-free by construction:
 
-    1. one small jitted dispatch computes the active mask, its population,
-       goal satisfaction and the offline flag (kernels.frontier_active);
-       a satisfied goal with no offline replicas exits immediately;
-    2. the population picks a power-of-two bucket (or dense when the
-       frontier covers most of the cluster / offline replicas need the
-       full healing path), candidate widths shrink with the bucket, and
-       the chunk dispatches through _goal_fixpoint_budget with the traced
-       FrontierInvariants;
-    3. the blocking packed fetch yields REAL per-chunk wall time, and the
-       accepted-actions-per-step rate drives the adaptive chunk length:
-       below ``tail_threshold`` × the peak rate the chunk halves (floored
-       at ``min_chunk``) so tail chunks stop burning 32 steps to admit a
-       handful of actions.
+    1. **Piggyback, don't probe.**  Every chunk program returns the
+       boundary-decision inputs in its own outputs — the packed
+       i32[PACKED_WIDTH] stats (satisfied/offline/capped/frontier
+       population) plus the post-chunk active mask — so the driver issues
+       at most ONE ``jax.device_get`` per boundary and never dispatches a
+       separate mask probe.  The first chunk runs dense (no mask exists
+       yet) and short, so quiet goals exit in a single small dispatch.
+    2. **Double-buffered speculative dispatch** (``speculate``, default on
+       when no ``on_chunk`` callback needs the intermediate models):
+       immediately after dispatching chunk *k* the driver launches chunk
+       *k+1* with the SAME bucket/shape and an on-device step budget of
+       ``packed_k[PACKED_CAPPED] * len`` — then fetches chunk *k*'s stats
+       while both run.  If chunk *k* converged the gate collapsed the
+       follow-up to zero steps (a bit-exact no-op, counted in
+       ``chunks_wasted``); if it capped, the follow-up was exactly the
+       chunk a synchronous driver would have dispatched, minus the idle
+       boundary.  Bucket changes and convergence decisions still block —
+       a speculative chunk runs on the predecessor's (stale) frontier,
+       which is sound because the mask is a performance hint, not a
+       correctness gate.
+    3. **Adaptive chunk growth**: when ``chunk_steps < max_steps`` chunks
+       start at ``min_chunk`` and double toward ``chunk_steps`` while the
+       accepted-actions-per-step rate stays above ``tail_threshold`` × the
+       peak rate, then halve in the tail — fast convergence detection
+       early, amortized boundaries while hot, short chunks in the tail.
 
+    The population fetched with the mask picks a power-of-two bucket (or
+    dense when the frontier covers most of the cluster / offline replicas
+    need the full healing path); candidate widths shrink with the bucket.
     A compacted chunk that reaches its fixpoint is CONFIRMED by a dense
-    chunk before the goal is declared converged (the mask is a performance
-    hint, not a correctness gate); a dense chunk converging is
-    authoritative.  ``on_chunk(model, chunk_record)`` runs after every
-    chunk — the sharded driver uses it for checkpointing.
+    chunk before the goal is declared converged; a dense chunk converging
+    is authoritative.  A goal satisfied with no offline replicas at a
+    boundary exits immediately.
+
+    ``on_chunk(model, chunk_record)`` runs after every fetched chunk — the
+    sharded driver uses it for checkpointing.  It disables speculation:
+    under donation a speculative dispatch consumes the predecessor model's
+    buffers before the callback could read them.
     """
     ns = num_sources or cgen.default_num_sources(model)
     nd = num_dests or cgen.default_num_dests(model)
     B = model.num_brokers
     use_frontier = bool(frontier) and kernels.is_band_kind(spec)
-    mask_fn = _get_frontier_mask_fn(spec, constraint) if use_frontier else None
+    if speculate is None:
+        speculate = True
+    speculate = bool(speculate) and on_chunk is None
     chunks: List[dict] = []
     buckets: set = set()
     fresh = False
@@ -1453,44 +1506,41 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
     repair_total = 0
     bisect_depth = 0
     lanes_total = 0
+    fetches = 0
+    fetch_wait = 0.0
+    speculated = 0
+    wasted = 0
     before0: Optional[bool] = None
     after = False
     capped = False
-    chunk = max(1, min(chunk_steps, max_steps))
+    grow = chunk_steps < max_steps
+    chunk = max(1, min(min_chunk if grow else chunk_steps,
+                       chunk_steps, max_steps))
     peak_aps = 0.0
     force_dense = not use_frontier
-    while steps_done < max_steps:
-        t0 = time.monotonic()
-        fr = None
-        bucket = None
-        cns, cnd = ns, nd
-        if not force_dense:
-            active_d, na_d, sat_d, off_d = mask_fn(model)
-            active_np, na, sat, off = jax.device_get(
-                (active_d, na_d, sat_d, off_d))
-            if before0 is None:
-                before0 = bool(sat)
-            if bool(sat) and not bool(off):
-                after = True
-                capped = False
-                break
-            if not bool(off):
-                bucket = _frontier_bucket(int(na), B)
-                if bucket is not None:
-                    fr = _build_frontier(np.asarray(active_np), bucket)
-                    cns, cnd = _frontier_widths(bucket, ns, nd)
-                    buckets.add(bucket)
-        budget = min(chunk, max_steps - steps_done)
+    bucket: Optional[int] = None  # config of the next host-decided dispatch
+    fr: Optional[FrontierInvariants] = None
+    pending: Optional[dict] = None  # the one in-flight speculative chunk
+    t_prev = time.monotonic()
+
+    def _dispatch(bucket, fr, budget, blen, speculative, confirm=False):
+        """Launch one chunk (async) and return its in-flight record.
+        ``budget`` is a host int for decided chunks or a device scalar for
+        gated speculative ones; both trace as strong i32 so every chunk of
+        one bucket shape shares ONE executable."""
+        nonlocal model, fresh, speculated
+        cns, cnd = (ns, nd) if bucket is None else _frontier_widths(bucket,
+                                                                    ns, nd)
         fn = _get_budget_fixpoint_fn(spec, prev_specs, constraint, cns, cnd,
                                      mesh=mesh, donate=donate)
         size0 = fn._cache_size() if hasattr(fn, "_cache_size") else None
-        model, packed = fn(model, options, budget, fr)
-        row = [int(x) for x in np.asarray(jax.device_get(packed))]
+        bud = budget if speculative else jnp.int32(budget)
+        model, packed_d, active_d = fn(model, options, bud, fr)
         # A chunk that built (or deserialized) its executable this process
         # carries that one-off wall in wall_s — flag it so the wall-slope
         # flatness metric can exclude it (tools/tail_report.py).
         chunk_fresh = size0 is not None and fn._cache_size() > size0
-        if size0 is not None and fn._cache_size() > size0:
+        if chunk_fresh:
             # New trace for this (goal, bucket shape) — refine "fresh" the
             # same way the stack path does: a persistent-cache marker means
             # some process already built this executable (warm disk cache).
@@ -1501,8 +1551,62 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
                 fresh = True
             if token:
                 compile_cache.mark(token)
-        wall = time.monotonic() - t0
-        s, a, b4, aft, cap, rep, dep, lan = row
+        FETCH_COUNTERS["chunks_dispatched"] += 1
+        if speculative:
+            FETCH_COUNTERS["chunks_speculative"] += 1
+            speculated += 1
+        return {"packed": packed_d, "active": active_d, "bucket": bucket,
+                "fr": fr, "ns": cns, "nd": cnd, "blen": blen,
+                "fresh": chunk_fresh, "speculative": speculative,
+                "confirm": confirm}
+
+    while steps_done < max_steps:
+        if pending is not None:
+            cur, pending = pending, None
+        else:
+            blen = min(chunk, max_steps - steps_done)
+            cur = _dispatch(bucket, fr, blen, blen, False,
+                            confirm=force_dense and use_frontier)
+        if speculate and not cur["confirm"] and (cur["bucket"] is not None
+                                                 or not use_frontier):
+            # Double buffer: gate the follow-up's budget on-device by cur's
+            # capped flag and launch it before the blocking fetch below, so
+            # the device never idles across the boundary.  The length is
+            # the optimistic (non-tail) growth-policy guess; cur's budget
+            # is charged in full — exact when cur caps (a capped chunk
+            # uses every step), and irrelevant when it converges (the gate
+            # zeroes the follow-up).  Confirm chunks are excluded (they
+            # exist to validate convergence and almost always no-op), as
+            # are dense chunks under the frontier policy — their follow-up
+            # usually switches to a compacted bucket, a different
+            # executable the host must pick after the fetch.
+            nxt = min(chunk * 2, chunk_steps) if grow else chunk
+            nxt = min(nxt, max_steps - steps_done - cur["blen"])
+            if nxt > 0:
+                gated = _get_gate_fn()(cur["packed"], jnp.int32(nxt))
+                pending = _dispatch(cur["bucket"], cur["fr"], gated, nxt,
+                                    True)
+        t_f = time.monotonic()
+        if use_frontier:
+            packed_np, active_np = jax.device_get((cur["packed"],
+                                                   cur["active"]))
+        else:
+            packed_np = jax.device_get(cur["packed"])
+            active_np = None
+        FETCH_COUNTERS["device_fetches"] += 1
+        fetches += 1
+        now = time.monotonic()
+        wait = now - t_f
+        fetch_wait += wait
+        # Boundary-to-boundary walls: fetches complete in dispatch order,
+        # so the delta between consecutive fetch completions is the real
+        # incremental wall of this chunk even when the next chunk was
+        # already running (per-dispatch stopwatches would double-count the
+        # overlap).
+        wall = now - t_prev
+        t_prev = now
+        (s, a, b4, aft, cap, rep, dep, lan, na, off) = (
+            int(x) for x in np.asarray(packed_np))
         if before0 is None:
             before0 = bool(b4)
         after = bool(aft)
@@ -1512,36 +1616,77 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
         repair_total += rep
         bisect_depth = max(bisect_depth, dep)
         lanes_total += lan
-        rec = {"steps": s, "actions": a, "wall_s": wall, "bucket": bucket,
-               "ns": cns, "nd": cnd, "repair_steps": rep,
+        if cur["bucket"] is not None:
+            buckets.add(cur["bucket"])
+        rec = {"steps": s, "actions": a, "wall_s": wall,
+               "fetch_wait_s": wait, "bucket": cur["bucket"],
+               "ns": cur["ns"], "nd": cur["nd"], "repair_steps": rep,
                "bisect_depth": dep, "lanes_live": lan,
-               "fresh_compile": chunk_fresh}
+               "fresh_compile": cur["fresh"],
+               "speculative": cur["speculative"]}
         chunks.append(rec)
         if on_chunk is not None:
             on_chunk(model, rec)
-        if not capped:
-            if fr is None:
-                break  # dense convergence is authoritative
-            # Compacted convergence: confirm with one dense chunk (the
-            # frontier may have hidden a legal move between two "inactive"
-            # brokers; in practice the mask is a superset of the kernels'
-            # source/sink sets, so the confirm is a no-op chunk).
-            force_dense = True
-            continue
-        if use_frontier:
-            force_dense = False
-        # Adaptive tail: halve the chunk when the accept rate collapses.
+        # Adaptive chunk length: grow while hot, halve in the tail.
         aps = a / max(s, 1)
         peak_aps = max(peak_aps, aps)
-        if peak_aps > 0 and aps < tail_threshold * peak_aps:
+        tail = peak_aps > 0 and aps < tail_threshold * peak_aps
+        if tail:
             chunk = max(min_chunk, chunk // 2)
+        elif grow:
+            chunk = min(chunk * 2, chunk_steps)
+        if not capped and cur["fr"] is not None:
+            # Compacted convergence — even a satisfied one — is confirmed
+            # with one dense chunk before the goal is declared done (the
+            # frontier may have hidden a legal move between two "inactive"
+            # brokers; in practice the mask is a superset of the kernels'
+            # source/sink sets, so the confirm is a no-op chunk).  Any
+            # in-flight follow-up's budget gate collapsed to zero steps.
+            if pending is not None:
+                wasted += 1
+                FETCH_COUNTERS["chunks_wasted"] += 1
+                pending = None
+            force_dense = True
+            bucket, fr = None, None
+            continue
+        if after and not off:
+            # Satisfied with nothing offline left: exit now.  An in-flight
+            # follow-up is a no-op either way — its own skip shortcut sees
+            # the satisfied state — so adopt its (bit-identical) model.
+            if pending is not None:
+                wasted += 1
+                FETCH_COUNTERS["chunks_wasted"] += 1
+                pending = None
+            capped = False
+            break
+        if not capped:
+            if pending is not None:
+                # The follow-up's budget gate collapsed to zero steps.
+                wasted += 1
+                FETCH_COUNTERS["chunks_wasted"] += 1
+                pending = None
+            break  # dense convergence is authoritative
+        # Capped: pick the next host-decided config from the mask that
+        # rode along with the chunk.  With a follow-up already in flight
+        # this takes effect one chunk late — the speculative chunk runs on
+        # the predecessor's frontier by design.
+        if use_frontier:
+            force_dense = False
+            bucket, fr = None, None
+            if not off:
+                nb = _frontier_bucket(na, B)
+                if nb is not None:
+                    fr = _build_frontier(np.asarray(active_np), nb)
+                    bucket = nb
     info = {"chunks": chunks, "buckets": sorted(buckets),
             "fresh_compile": fresh, "steps": steps_done,
             "actions": actions_total,
             "satisfied_before": bool(before0) if before0 is not None else after,
             "satisfied_after": after, "capped": capped,
             "repair_steps": repair_total, "bisect_depth": bisect_depth,
-            "lanes_live": lanes_total}
+            "lanes_live": lanes_total, "fetches": fetches,
+            "fetch_wait_s": fetch_wait, "chunks_speculative": speculated,
+            "chunks_wasted": wasted}
     return model, info
 
 
@@ -1588,23 +1733,22 @@ def _stack_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
     worker; see optimize(fuse_group_size=...)).
 
     Each goal runs through _goal_fixpoint_budget so the packed result is
-    one i32[8, G] matrix — (steps, actions, before, after, capped,
-    repair_steps, bisect_depth, lanes_live) per goal — and the grouped
-    path reports the bounded-repair counters just like the per-goal
+    one i32[PACKED_WIDTH, G] matrix (slot layout in state.py) — and the
+    grouped path reports the bounded-repair counters just like the per-goal
     frontier driver does."""
     packed_l = []
     prev: Tuple[GoalSpec, ...] = tuple(prev_specs)
     for spec in specs:
-        model, packed = _goal_fixpoint_budget(
+        model, packed, _ = _goal_fixpoint_budget(
             model, options, jnp.int32(max_steps), None, spec=spec,
             prev_specs=prev, constraint=constraint,
             num_sources=num_sources, num_dests=num_dests, mesh=mesh,
             repair_oracle=repair_oracle)
         packed_l.append(packed)
         prev = prev + (spec,)
-    # One i32[8, G] result matrix: a single host fetch covers the whole run
-    # (each device_get round trip costs ~0.5-1 s over a tunneled TPU;
-    # separate vectors were separate round trips).
+    # One i32[PACKED_WIDTH, G] result matrix: a single host fetch covers the
+    # whole run (each device_get round trip costs ~0.5-1 s over a tunneled
+    # TPU; separate vectors were separate round trips).
     return model, jnp.stack(packed_l, axis=1)
 
 
@@ -1626,6 +1770,26 @@ def _push_repair_sensors(goal_name: str, repair_steps: int,
         "GoalOptimizer.repair-bisect-depth", labels=labels,
         help="Compiled repair bisection depth (log2 of lane count)",
     ).set(bisect_depth)
+
+
+def _push_dispatch_sensors(goal_name: str, fetches: int,
+                           chunks_speculative: int, chunks_wasted: int) -> None:
+    """Async-orchestration counters into the sensor registry: how often the
+    chunk driver blocked on the device, and how much speculative dispatch
+    bought (launched) and burned (gated to zero)."""
+    labels = {"goal": goal_name}
+    SENSORS.counter(
+        "GoalOptimizer.device-fetches", labels=labels,
+        help="Blocking host fetches at chunk boundaries",
+    ).inc(fetches)
+    SENSORS.counter(
+        "GoalOptimizer.chunks-speculative", labels=labels,
+        help="Chunks dispatched before the predecessor's stats were fetched",
+    ).inc(chunks_speculative)
+    SENSORS.counter(
+        "GoalOptimizer.chunks-wasted", labels=labels,
+        help="Speculative chunks whose on-device budget gate zeroed them",
+    ).inc(chunks_wasted)
 
 
 _stack_cache: Dict[tuple, object] = {}
@@ -1683,6 +1847,15 @@ class GoalResult:
     repair_steps: int = 0
     bisect_depth: int = 0
     lanes_live: int = 0
+    # Dispatch/fetch accounting of the async chunk driver (zeros on paths
+    # without per-goal chunking): blocking host fetches at chunk
+    # boundaries, seconds spent blocked in them, follow-up chunks launched
+    # before their predecessor's stats were fetched, and the subset whose
+    # on-device budget gate collapsed to a zero-step no-op.
+    fetches: int = 0
+    fetch_wait_s: float = 0.0
+    chunks_speculative: int = 0
+    chunks_wasted: int = 0
 
 
 @dataclasses.dataclass
@@ -1766,7 +1939,10 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
                          fresh_compile=g.fresh_compile,
                          repair_steps=g.repair_steps,
                          bisect_depth=g.bisect_depth,
-                         lanes_live=g.lanes_live)
+                         lanes_live=g.lanes_live,
+                         fetches=g.fetches,
+                         chunks_speculative=g.chunks_speculative,
+                         chunks_wasted=g.chunks_wasted)
         sp.annotate(actions=sum(g.actions_applied for g in run.goal_results),
                     steps=sum(g.steps for g in run.goal_results),
                     candidates_scored=run.num_candidates_scored)
@@ -1873,6 +2049,12 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
         nd = max(8, ceiling // ns)
         if ns * nd > ceiling:
             ns = max(64, ceiling // nd)
+        SENSORS.counter(
+            "GoalOptimizer.compile-ceiling-clamps",
+            labels={"ceiling": ceiling},
+            help="Candidate-width clamps caused by the opt-in "
+                 "remote-compile ceiling (CRUISE_TPU_COMPILE_CEILING)",
+        ).inc(1)
         _LOG.info(
             "compile ceiling %d clamped candidate widths: num_sources "
             "%d -> %d, num_dests %d -> %d (set CRUISE_TPU_COMPILE_CEILING="
@@ -1985,11 +2167,19 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
                     chunks=info["chunks"],
                     repair_steps=info.get("repair_steps", 0),
                     bisect_depth=info.get("bisect_depth", 0),
-                    lanes_live=info.get("lanes_live", 0)))
+                    lanes_live=info.get("lanes_live", 0),
+                    fetches=info.get("fetches", 0),
+                    fetch_wait_s=info.get("fetch_wait_s", 0.0),
+                    chunks_speculative=info.get("chunks_speculative", 0),
+                    chunks_wasted=info.get("chunks_wasted", 0)))
                 _push_repair_sensors(spec.name,
                                      info.get("repair_steps", 0),
                                      info.get("bisect_depth", 0),
                                      info.get("lanes_live", 0))
+                _push_dispatch_sensors(spec.name,
+                                       info.get("fetches", 0),
+                                       info.get("chunks_speculative", 0),
+                                       info.get("chunks_wasted", 0))
                 if spec.is_hard and not info["satisfied_after"] \
                         and raise_on_hard_failure:
                     raise OptimizationFailureException(
@@ -2004,9 +2194,43 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
             fresh_v: List[bool] = []
             durations: List[float] = []
             prev: Tuple[GoalSpec, ...] = ()
+            # One-ahead pipeline: dispatch chunk i+1 (tracing/compiling its
+            # program on the host while the device runs chunk i) BEFORE
+            # fetching chunk i's packed stats, so chunk boundaries cost no
+            # device idle.  Fetches complete in dispatch order, so the
+            # delta between consecutive fetch completions is each chunk's
+            # real incremental wall — split evenly across its goals, as
+            # before.  The default auto config uses one chunk for small
+            # models, where the pipeline degenerates to dispatch + fetch.
+            inflight: List[tuple] = []  # (goal_chunk, packed_d, fresh)
+            t_prev = time.monotonic()
+            # One blocking fetch per group chunk; attributed to the chunk's
+            # lead goal (a group shares its packed fetch, so per-goal split
+            # would be fiction).  The one-ahead dispatch is unconditional,
+            # not speculative — every chunk is needed — so the speculation
+            # counters stay 0 on this path.
+            fetch_of: Dict[str, int] = {}
+            fetch_wait_of: Dict[str, float] = {}
+
+            def _drain_one():
+                nonlocal t_prev
+                goal_chunk, packed_d, chunk_fresh = inflight.pop(0)
+                t_get = time.monotonic()
+                packed_rows.append(np.asarray(jax.device_get(packed_d)))
+                FETCH_COUNTERS["device_fetches"] += 1
+                now = time.monotonic()
+                lead = goal_chunk[0].name
+                fetch_of[lead] = fetch_of.get(lead, 0) + 1
+                fetch_wait_of[lead] = fetch_wait_of.get(lead, 0.0) \
+                    + (now - t_get)
+                _push_dispatch_sensors(lead, 1, 0, 0)
+                durations.extend([(now - t_prev) / len(goal_chunk)]
+                                 * len(goal_chunk))
+                fresh_v.extend([chunk_fresh] * len(goal_chunk))
+                t_prev = now
+
             for start in range(0, len(specs), group):
                 chunk = tuple(specs[start:start + group])
-                t_chunk = time.monotonic()
                 n_cached = len(_stack_cache)
                 stack_fn = _get_stack_fn(chunk, constraint, ns, nd,
                                          max_steps_per_goal, mesh=mesh,
@@ -2024,17 +2248,13 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
                 model, packed = stack_fn(model, options)
                 if token:
                     compile_cache.mark(token)
-                # Blocking per-chunk fetch: the device sync that makes wall
-                # attribution real — each chunk's wall lands only on its
-                # own goals (the old single deferred fetch divided the
-                # TOTAL across every goal).  Within a chunk the split is
-                # still even; the default auto config uses one chunk for
-                # small models, so the round-trip count is unchanged there.
-                packed_rows.append(np.asarray(jax.device_get(packed)))
-                chunk_wall = time.monotonic() - t_chunk
-                durations.extend([chunk_wall / len(chunk)] * len(chunk))
-                fresh_v.extend([chunk_fresh] * len(chunk))
+                FETCH_COUNTERS["chunks_dispatched"] += 1
+                inflight.append((chunk, packed, chunk_fresh))
+                if len(inflight) > 1:
+                    _drain_one()
                 prev = prev + chunk
+            while inflight:
+                _drain_one()
             # Async host copies of the result arrays the caller reads next
             # (props.diff): the immutable leaves are the same buffers in
             # the initial model, so prefetching covers both diff sides.
@@ -2060,7 +2280,9 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
                     fresh_compile=fresh_v[i],
                     repair_steps=int(repair_v[i]),
                     bisect_depth=int(depth_v[i]),
-                    lanes_live=int(lanes_v[i])))
+                    lanes_live=int(lanes_v[i]),
+                    fetches=fetch_of.get(spec.name, 0),
+                    fetch_wait_s=fetch_wait_of.get(spec.name, 0.0)))
                 _push_repair_sensors(spec.name, int(repair_v[i]),
                                      int(depth_v[i]), int(lanes_v[i]))
                 if spec.is_hard and not bool(after_v[i]) \

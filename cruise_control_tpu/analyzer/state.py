@@ -105,6 +105,31 @@ class FrontierInvariants:
     full_of_compact: Array  # i32[Bc] — full broker id per compact slot, -1 pad
 
 
+# ---------------------------------------------------------------------------
+# Packed per-chunk stats layout
+# ---------------------------------------------------------------------------
+# ``optimizer._goal_fixpoint_budget`` returns one i32[PACKED_WIDTH] vector per
+# chunk so the whole chunk-boundary decision — did the goal converge, is it
+# satisfied, are offline replicas left, how big is the next frontier — rides
+# in ONE host transfer alongside the active mask.  The layout is shared by the
+# per-goal chunk driver, the grouped-stack i32[PACKED_WIDTH, G] matrix, the
+# sharded driver, and tools/dispatch_report.py; extend it by appending (the
+# first 8 slots predate the orchestration fields and are pinned by recorded
+# bench artifacts).
+
+PACKED_STEPS = 0          # steps executed this chunk
+PACKED_ACTIONS = 1        # actions accepted this chunk
+PACKED_BEFORE = 2         # goal satisfied at chunk entry (0/1)
+PACKED_AFTER = 3          # goal satisfied at chunk exit (0/1)
+PACKED_CAPPED = 4         # hit the step budget while still applying (0/1)
+PACKED_REPAIR_STEPS = 5   # steps whose selection repair saw a violation
+PACKED_BISECT_DEPTH = 6   # max compiled repair bisection depth
+PACKED_LANES_LIVE = 7     # live candidate lanes at compaction, summed
+PACKED_NUM_ACTIVE = 8     # frontier population at chunk exit; -1 = non-band
+PACKED_ANY_OFFLINE = 9    # offline replicas remain at chunk exit (0/1)
+PACKED_WIDTH = 10
+
+
 @struct.dataclass
 class OptimizationOptions:
     """Traced per-request constraints (analyzer/OptimizationOptions.java:16).

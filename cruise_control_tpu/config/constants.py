@@ -168,11 +168,14 @@ def analyzer_config_def() -> ConfigDef:
              doc="Compile the default goal stack against the current cluster shape at "
                  "startup so the first rebalance request pays no compile wait (cheap "
                  "when the persistent compile cache is already warm).", group="analyzer")
-    d.define(TPU_COMPILE_CEILING_CONFIG, Type.STRING, "auto", importance=Importance.LOW,
+    d.define(TPU_COMPILE_CEILING_CONFIG, Type.STRING, "off", importance=Importance.LOW,
              doc="Candidate-batch compile ceiling gate (propagated to the "
-                 "CRUISE_TPU_COMPILE_CEILING env var): 'auto' caps S*D batches at "
-                 "32768 only on the tpu backend, 'off' disables the cap, an integer "
-                 "imposes that cap on any backend.", group="analyzer")
+                 "CRUISE_TPU_COMPILE_CEILING env var): 'off' (default) never caps, "
+                 "'auto' caps S*D batches at 32768 on the tpu backend (set this for "
+                 "deployments on a tunneled TPU, whose remote-compile service hangs "
+                 "on wide programs), an integer imposes that cap on any backend. "
+                 "Clamps are counted by GoalOptimizer.compile-ceiling-clamps.",
+             group="analyzer")
     return d
 
 

@@ -118,17 +118,22 @@ def distributed_frontier_fixpoint(model: TensorClusterModel, spec: GoalSpec,
                                   max_steps: int = 256, chunk_steps: int = 32,
                                   num_sources: Optional[int] = None,
                                   num_dests: Optional[int] = None,
-                                  on_chunk=None, frontier: bool = True):
+                                  on_chunk=None, frontier: bool = True,
+                                  speculate: Optional[bool] = None):
     """Shrinking-frontier chunk driver under the device mesh: identical
-    orchestration to ``optimizer.frontier_fixpoint`` (frontier mask probe at
-    each chunk boundary, power-of-two compaction buckets, adaptive chunk
-    length, dense confirm) with every dispatch — the mask probe and the
-    budget fixpoint — lowered through GSPMD over ``mesh``.  The compaction
-    index maps are tiny host tensors; GSPMD replicates them and shards the
-    candidate batch exactly as the dense sharded step does.  Returns
-    ``(model, info)`` — see frontier_fixpoint."""
+    orchestration to ``optimizer.frontier_fixpoint`` (boundary stats and
+    frontier mask piggybacked on each chunk's packed output, double-buffered
+    speculative dispatch, adaptive chunk growth, power-of-two compaction
+    buckets, dense confirm) with every chunk dispatch lowered through GSPMD
+    over ``mesh``.  The compaction index maps are tiny host tensors; GSPMD
+    replicates them and shards the candidate batch exactly as the dense
+    sharded step does.  An ``on_chunk`` checkpoint callback disables
+    speculation (the callback must observe every intermediate model before
+    the next dispatch may consume its buffers); ``speculate`` forces it
+    off/on otherwise.  Returns ``(model, info)`` — see frontier_fixpoint."""
     from cruise_control_tpu.analyzer.optimizer import frontier_fixpoint
     return frontier_fixpoint(model, options, spec, prev_specs, constraint,
                              num_sources=num_sources, num_dests=num_dests,
                              max_steps=max_steps, chunk_steps=chunk_steps,
-                             mesh=mesh, frontier=frontier, on_chunk=on_chunk)
+                             mesh=mesh, frontier=frontier, on_chunk=on_chunk,
+                             speculate=speculate)

@@ -66,6 +66,6 @@ def _clear_jax_caches_between_modules():
     _opt._fixpoint_cache.clear()
     _opt._stack_cache.clear()
     _opt._budget_cache.clear()
-    _opt._frontier_mask_cache.clear()
+    _opt._gate_fn = None
     _opt._sweep_cache.clear()
     jax.clear_caches()

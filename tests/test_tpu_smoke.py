@@ -14,6 +14,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 
 import pytest
 
@@ -39,6 +40,11 @@ platform = jax.devices()[0].platform
 t.cancel()
 print(json.dumps({"probe": "backend", "ok": platform == "tpu",
                   "platform": platform}), flush=True)
+if platform != "tpu":
+    # The fixture skips the whole lane on a failed backend probe and
+    # ignores every other result, so don't burn minutes compiling the
+    # probes on whatever backend did come up.
+    sys.exit(0)
 
 import jax.numpy as jnp
 from cruise_control_tpu.analyzer import optimizer as opt
@@ -100,14 +106,40 @@ def tpu_probe_results():
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
     script = _PROBE_SCRIPT.replace("%INIT%", str(_INIT_TIMEOUT_S))
+    proc = subprocess.Popen([sys.executable, "-c", script], cwd=_REPO,
+                            env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    # Enforce the init timeout from OUT HERE: libtpu's metadata retries can
+    # stall backend init in native code with the GIL held, so the probe
+    # script's own watchdog thread never gets to run.  The backend probe
+    # line is the first thing the script prints; if it hasn't arrived
+    # within the init budget, the backend didn't come up.
+    stdout_lines = []
+    got_first = threading.Event()
+
+    def _drain():
+        for line in proc.stdout:
+            stdout_lines.append(line)
+            got_first.set()
+        got_first.set()
+
+    reader = threading.Thread(target=_drain, daemon=True)
+    reader.start()
+    if not got_first.wait(_INIT_TIMEOUT_S) or not stdout_lines:
+        proc.kill()
+        proc.wait()
+        pytest.skip(f"TPU backend init produced no probe line within "
+                    f"{_INIT_TIMEOUT_S:.0f}s (tunnel down?)")
     try:
-        proc = subprocess.run([sys.executable, "-c", script], cwd=_REPO,
-                              env=env, capture_output=True, text=True,
-                              timeout=_RUN_TIMEOUT_S)
+        proc.wait(timeout=_RUN_TIMEOUT_S)
     except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
         pytest.skip("TPU smoke subprocess timed out (wedged tunnel?)")
+    reader.join(timeout=10)
+    stderr = proc.stderr.read()
     results = {}
-    for line in proc.stdout.splitlines():
+    for line in stdout_lines:
         try:
             rec = json.loads(line)
             results[rec["probe"]] = rec
@@ -116,10 +148,10 @@ def tpu_probe_results():
     backend = results.get("backend", {})
     if not backend.get("ok"):
         pytest.skip(f"TPU backend unavailable: {backend} "
-                    f"(stderr tail: {proc.stderr[-300:]!r})")
+                    f"(stderr tail: {stderr[-300:]!r})")
     if proc.returncode != 0:
         pytest.fail(f"TPU probe subprocess rc={proc.returncode}; "
-                    f"stderr tail: {proc.stderr[-2000:]}")
+                    f"stderr tail: {stderr[-2000:]}")
     return results
 
 

@@ -1,0 +1,199 @@
+"""Per-goal dispatch/round-trip report from a bench record, plus a live
+fetch audit.
+
+Report mode (default): read a bench JSON record (BASELINE.json, a
+BENCH_*.json, or any ``per_goal`` record bench.py emits) and print one row
+per goal — blocking host fetches, chunks, speculative/wasted chunks, wall
+blocked in ``device_get`` (the chunk-boundary seconds), and total wall —
+then the record-level ``dispatch`` counters.  A goal whose fetch count
+exceeds its chunk count means a probe crept back into the boundary path;
+the row is flagged.
+
+Audit mode (``--audit``): run the mid bench rung (or ``--rung``) on the
+current backend with ``jax.device_get`` wrapped by a counter, and emit a
+JSON line pinning the measured host-fetch budget: total ``device_get``
+calls, the driver-attributed fetches (optimizer.FETCH_COUNTERS), chunk
+boundaries, and fetches per boundary.  The wrapper counts EVERY device_get
+in the process, so the audit is independent of the driver's own
+bookkeeping — it holds whatever the code under audit does, which makes the
+number comparable across code revisions.
+
+Usage:
+    python tools/dispatch_report.py BENCH.json
+    JAX_PLATFORMS=cpu python tools/dispatch_report.py --audit [--rung mid]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def goal_rows(record: dict) -> list:
+    """One row per goal from a bench record's per_goal block; tolerates
+    pre-async records (missing keys read as 0 so old/new records diff
+    cleanly side by side)."""
+    rows = []
+    for name, g in record.get("per_goal", {}).items():
+        chunks = g.get("chunks", [])
+        fetches = int(g.get("fetches", 0))
+        rows.append({
+            "goal": name,
+            "fetches": fetches,
+            "chunks": len(chunks),
+            "chunks_speculative": int(g.get("chunks_speculative", 0)),
+            "chunks_wasted": int(g.get("chunks_wasted", 0)),
+            "fetch_wait_s": float(g.get("fetch_wait_s", 0.0)),
+            "wall_s": float(g.get("wall_s", 0.0)),
+            "probe_leak": bool(chunks) and fetches > len(chunks),
+        })
+    return rows
+
+
+def report(record: dict) -> dict:
+    rows = goal_rows(record)
+    out = {
+        "metric": "dispatch_report",
+        "source_metric": record.get("metric"),
+        "goals": rows,
+        "total_fetches": sum(r["fetches"] for r in rows),
+        "total_fetch_wait_s": round(sum(r["fetch_wait_s"] for r in rows), 3),
+        "total_chunks": sum(r["chunks"] for r in rows),
+    }
+    if "dispatch" in record:
+        out["dispatch"] = record["dispatch"]
+    return out
+
+
+def print_table(rep: dict) -> None:
+    cols = ("goal", "fetches", "chunks", "chunks_speculative",
+            "chunks_wasted", "fetch_wait_s", "wall_s")
+    head = ("goal", "fetches", "chunks", "spec", "wasted", "boundary_s",
+            "wall_s")
+    rows = [[str(r[c]) if c == "goal"
+             else (f"{r[c]:.3f}" if isinstance(r[c], float) else str(r[c]))
+             for c in cols] + (["PROBE-LEAK"] if r["probe_leak"] else [""])
+            for r in rep["goals"]]
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(head)]
+    print("  ".join(h.ljust(w) for h, w in zip(head, widths)))
+    for r in rows:
+        print("  ".join(c.ljust(w) for c, w in zip(r, widths))
+              + (f"  {r[-1]}" if r[-1] else ""))
+    print(f"total: fetches={rep['total_fetches']} "
+          f"chunks={rep['total_chunks']} "
+          f"boundary_wait={rep['total_fetch_wait_s']}s")
+    if "dispatch" in rep:
+        print(f"dispatch counters: {json.dumps(rep['dispatch'])}")
+
+
+def run_audit(rung: str) -> dict:
+    """Run one bench rung with jax.device_get wrapped by an independent
+    counter and pin the fetch budget.  The wrapper sees every blocking
+    host fetch regardless of which code path issued it — the point is a
+    number an older revision can be measured against."""
+    import jax
+
+    import bench
+    from cruise_control_tpu.analyzer import optimizer as opt
+
+    from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster
+
+    brokers, racks, topics, ppt, rf = bench.SCALES[rung]
+    spec = ClusterSpec(num_brokers=brokers, num_racks=racks,
+                       num_topics=topics, mean_partitions_per_topic=ppt,
+                       replication_factor=rf, distribution="exponential",
+                       seed=2026)
+    model = jax.device_put(generate_cluster(spec))
+    jax.block_until_ready(model)
+
+    # Warm-up off the audit clock (compiles fetch nothing we care about).
+    opt.optimize(opt.donation_copy(model), bench.STACK,
+                 raise_on_hard_failure=False, fused=True, donate_model=True)
+
+    audit = {"device_get_calls": 0, "device_get_wait_s": 0.0}
+    real_get = jax.device_get
+
+    def counting_get(x):
+        t0 = time.monotonic()
+        out = real_get(x)
+        audit["device_get_calls"] += 1
+        audit["device_get_wait_s"] += time.monotonic() - t0
+        return out
+
+    # FETCH_COUNTERS landed with the async driver; running this audit
+    # against an older revision (the whole point of an independent counter)
+    # must still work, with driver attribution reading 0.
+    zeros = {"device_fetches": 0, "chunks_dispatched": 0,
+             "chunks_speculative": 0, "chunks_wasted": 0}
+    counters = getattr(opt, "FETCH_COUNTERS", zeros)
+    before = dict(counters)
+    jax.device_get = counting_get
+    try:
+        t0 = time.monotonic()
+        run = opt.optimize(opt.donation_copy(model), bench.STACK,
+                           raise_on_hard_failure=False, fused=True,
+                           donate_model=True)
+        wall = time.monotonic() - t0
+    finally:
+        jax.device_get = real_get
+    driver = {k: counters[k] - before[k] for k in before}
+    boundaries = sum(len(g.chunks or []) for g in run.goal_results) or driver[
+        "device_fetches"]
+    return {
+        "metric": f"dispatch_audit_{rung}",
+        "backend": jax.devices()[0].platform,
+        "wall_s": round(wall, 3),
+        "device_get_calls": audit["device_get_calls"],
+        "device_get_wait_s": round(audit["device_get_wait_s"], 3),
+        "driver_fetches": driver["device_fetches"],
+        "chunks_dispatched": driver["chunks_dispatched"],
+        "chunks_speculative": driver["chunks_speculative"],
+        "chunks_wasted": driver["chunks_wasted"],
+        "chunk_boundaries": boundaries,
+        "fetches_per_boundary": round(
+            driver["device_fetches"] / max(boundaries, 1), 3),
+        "boundary_wait_s": round(sum(getattr(g, "fetch_wait_s", 0.0)
+                                     for g in run.goal_results), 3),
+        # Work totals so cross-revision audits can check they compared
+        # equal optimizations, not different convergence paths.
+        "steps": sum(g.steps for g in run.goal_results),
+        "actions": sum(g.actions_applied for g in run.goal_results),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("record", nargs="?", help="bench JSON record to report")
+    ap.add_argument("--audit", action="store_true",
+                    help="run a live rung with device_get wrapped")
+    ap.add_argument("--rung", default="mid", help="audit rung (default mid)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the report as one JSON line (no table)")
+    args = ap.parse_args()
+    if args.audit:
+        rec = run_audit(args.rung)
+        print(json.dumps(rec), flush=True)
+        return
+    if not args.record:
+        ap.error("need a bench record path (or --audit)")
+    with open(args.record) as f:
+        text = f.read().strip()
+    # Accept both a single JSON object and a .jsonl (last line wins).
+    record = json.loads(text.splitlines()[-1])
+    if "per_goal" not in record and "rungs" in record:
+        record = record["rungs"][-1]
+    rep = report(record)
+    if args.json:
+        print(json.dumps(rep), flush=True)
+    else:
+        print_table(rep)
+
+
+if __name__ == "__main__":
+    main()

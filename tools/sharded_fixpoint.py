@@ -160,10 +160,14 @@ def main():
                   flush=True)
         if use_frontier:
             # Shrinking-frontier driver: the chunk loop lives in
-            # optimizer.frontier_fixpoint (mask probe, compaction buckets,
-            # adaptive chunk length, dense confirm); on_chunk keeps the
-            # checkpoint cadence of the legacy loop.  The remaining step
-            # budget seeds from the recorded chunks so resume is exact.
+            # optimizer.frontier_fixpoint (boundary stats and frontier mask
+            # piggybacked on each chunk's packed output, compaction
+            # buckets, adaptive chunk growth, dense confirm); on_chunk
+            # keeps the checkpoint cadence of the legacy loop and thereby
+            # disables speculative dispatch — each intermediate model must
+            # be observable before the next dispatch may consume its
+            # buffers.  The remaining step budget seeds from the recorded
+            # chunks so resume is exact.
             budget = chunk * max_chunks - steps
             if capped and budget > 0:
                 def on_chunk(m, rec):
@@ -177,7 +181,9 @@ def main():
                                    "ns": rec["ns"], "nd": rec["nd"],
                                    "repair_steps": rec.get("repair_steps", 0),
                                    "bisect_depth": rec.get("bisect_depth", 0),
-                                   "lanes_live": rec.get("lanes_live", 0)})
+                                   "lanes_live": rec.get("lanes_live", 0),
+                                   "fetch_wait_s": round(
+                                       rec.get("fetch_wait_s", 0.0), 3)})
                     progress["current"] = {
                         "name": name, "chunks": chunks,
                         "satisfied_before": before0,

@@ -40,6 +40,7 @@ import json
 from functools import partial
 
 import jax
+import jax.numpy as jnp
 
 jax.config.update("jax_platforms", "cpu")  # never init the tunneled TPU here
 
@@ -232,7 +233,9 @@ def chunk_reuse_report(goal: str = "ReplicaDistributionGoal",
     # Dense: every chunk length through the one traced-budget executable.
     dense_fn = opt._get_budget_fixpoint_fn(g, (), constraint, ns, nd)
     for budget in budgets:
-        m2, packed = dense_fn(model, options, budget, None)
+        # Strong-i32 budgets, exactly as the chunk driver passes them (a
+        # weak python-int scalar would trace a second executable).
+        m2, packed, _ = dense_fn(model, options, jnp.int32(budget), None)
         jax.block_until_ready(packed)
         dispatches += 1
     dense_execs = dense_fn._cache_size()
@@ -247,7 +250,7 @@ def chunk_reuse_report(goal: str = "ReplicaDistributionGoal",
         fn = opt._get_budget_fixpoint_fn(g, (), constraint, cns, cnd)
         size0 = fn._cache_size()
         for budget in budgets[-2:]:
-            m2, packed = fn(model, options, budget, fr)
+            m2, packed, _ = fn(model, options, jnp.int32(budget), fr)
             jax.block_until_ready(packed)
             dispatches += 1
         per_bucket[bucket] = fn._cache_size() - size0
