@@ -263,9 +263,19 @@ def run_rung(scale: str, max_candidates, fast: bool) -> dict:
             "chunks_speculative": g.chunks_speculative,
             "chunks_wasted": g.chunks_wasted,
             **({"chunks": g.chunks} if g.chunks else {}),
+            **({"flight": g.flight} if g.flight is not None else {}),
         } for g in run.goal_results},
         **({"fast_mode": True} if fast else {}),
     }
+    # Flight-recorder artifact: with --flight (CRUISE_FLIGHT_RECORDER=1)
+    # the per-goal timelines above are also distilled into FLIGHT_<rung>.json
+    # so the convergence curves survive as a comparable recorded artifact.
+    if any(g.flight is not None for g in run.goal_results):
+        from tools.flight_report import write_artifact
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            f"FLIGHT_{scale}.json")
+        write_artifact(rec, path)
+        rec["flight_artifact"] = os.path.basename(path)
     # Flat-wall guard: with the bounded-depth repair, same-shape chunks of
     # one goal must cost the same per step.  A slope beyond 1.5× means
     # data-dependent work crept back into the step graph — fail the rung
@@ -320,7 +330,13 @@ def main() -> None:
     ap.add_argument("--rung-timeout", type=float, default=None,
                     help="per-rung wall budget in seconds "
                          "(default BENCH_RUNG_TIMEOUT_S or 1800)")
+    ap.add_argument("--flight", action="store_true",
+                    help="record per-step flight telemetry "
+                         "(CRUISE_FLIGHT_RECORDER=1) and write a "
+                         "FLIGHT_<rung>.json artifact per rung")
     args = ap.parse_args()
+    if args.flight:
+        os.environ["CRUISE_FLIGHT_RECORDER"] = "1"
     scale_sel = args.rungs or os.environ.get("BENCH_SCALE") or "small,mid"
     scales = (["small", "mid", "large"] if scale_sel == "ladder"
               else [s.strip() for s in scale_sel.split(",") if s.strip()])

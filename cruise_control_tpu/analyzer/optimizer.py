@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import math
 import os
 import time
 from functools import partial
@@ -50,7 +51,11 @@ from cruise_control_tpu.analyzer.actions import Candidates, apply_candidates
 from cruise_control_tpu.analyzer.balancing_constraint import BalancingConstraint
 from cruise_control_tpu.analyzer.goals import kernels
 from cruise_control_tpu.analyzer.goals.specs import GoalSpec, goals_by_priority
-from cruise_control_tpu.analyzer.state import (PACKED_CAPPED, BrokerArrays,
+from cruise_control_tpu.analyzer.state import (FLIGHT_ACTIONS, FLIGHT_BISECT,
+                                               FLIGHT_FRONTIER, FLIGHT_KIND,
+                                               FLIGHT_LANES, FLIGHT_REPAIR,
+                                               FLIGHT_SCORE_BITS, FLIGHT_WIDTH,
+                                               PACKED_CAPPED, BrokerArrays,
                                                FrontierInvariants,
                                                OptimizationOptions,
                                                StepInvariants, pow2_bucket)
@@ -87,6 +92,23 @@ def _repair_oracle() -> bool:
     the env var mid-process selects a different executable, never a stale
     one."""
     return os.environ.get("CRUISE_REPAIR_ORACLE", "").strip() == "1"
+
+
+def _flight_recorder() -> bool:
+    """CRUISE_FLIGHT_RECORDER=1 turns on the solve flight recorder: the
+    budget fixpoint carries an i32[C, FLIGHT_WIDTH] per-step telemetry
+    buffer that piggybacks on the existing single boundary fetch.  Like
+    ``_repair_oracle`` the flag is read by every _get_* cache constructor
+    so it is part of the python cache key — recorder-on and recorder-off
+    are different executables and never contaminate each other (the off
+    program is byte-for-byte the pre-recorder graph, keeping the
+    step-graph equation ceilings and bit-identity trivially intact)."""
+    return os.environ.get("CRUISE_FLIGHT_RECORDER", "").strip() == "1"
+
+
+#: Canonical order of the candidate-kind segments ``_goal_step`` concatenates;
+#: ``FLIGHT_KIND`` rows index into this tuple (-1 = no action kept).
+FLIGHT_KINDS = ("move", "leadership", "intra_move", "swap", "intra_swap")
 
 
 # Below this K the selection rounds always run on the full lane axis:
@@ -968,12 +990,17 @@ def _goal_step(model: TensorClusterModel, options: OptimizationOptions,
                num_sources: int, num_dests: int, mesh=None,
                invariants: Optional[StepInvariants] = None,
                frontier: Optional[FrontierInvariants] = None,
-               repair_oracle: bool = False):
+               repair_oracle: bool = False, flight: bool = False):
     """One optimization step for ``spec``: returns
     ``(new_model, num_applied, sel_stats)`` where ``sel_stats`` is the
     selection's ``(repair_fired, lanes_live, bisect_depth)`` i32 scalars
     (see select_batched).  ``repair_oracle`` selects the legacy
-    data-dependent repair path (CRUISE_REPAIR_ORACLE=1).
+    data-dependent repair path (CRUISE_REPAIR_ORACLE=1).  ``flight``
+    (static, CRUISE_FLIGHT_RECORDER=1) appends a fourth element — the
+    flight-recorder extras ``(frontier_count, score_bits, kind)`` i32
+    scalars — computed purely from already-materialized step values, so
+    the selection itself is untouched and recorder-on proposals stay
+    bit-identical to recorder-off.
 
     Static args (spec, prev_specs, constraint, widths, mesh) select the
     compiled graph; model/options are traced.  With ``mesh`` set, the
@@ -1008,6 +1035,7 @@ def _goal_step(model: TensorClusterModel, options: OptimizationOptions,
                               -jnp.inf)
 
     batches = []
+    kind_ids = []  # FLIGHT_KINDS index per batch, parallel to ``batches``
     if spec.uses_moves:
         # The 1:1 transport-matched batch drains count surpluses at batch
         # width (see matched_move_candidates); the cross batch stays as
@@ -1035,16 +1063,19 @@ def _goal_step(model: TensorClusterModel, options: OptimizationOptions,
             spec, model, arrays, constraint, options, cross_ns, num_dests,
             num_matched=num_matched, relevance=relevance, bands=bands,
             active=active))
+        kind_ids.append(FLIGHT_KINDS.index("move"))
     if spec.uses_leadership:
         batches.append(cgen.leadership_candidates(spec, model, arrays, constraint,
                                                   options, num_sources,
                                                   relevance=relevance,
                                                   bands=bands))
+        kind_ids.append(FLIGHT_KINDS.index("leadership"))
     if spec.uses_intra_moves:
         batches.append(cgen.intra_disk_candidates(spec, model, arrays, constraint,
                                                   options, num_sources,
                                                   relevance=relevance,
                                                   bands=bands))
+        kind_ids.append(FLIGHT_KINDS.index("intra_move"))
     # Swap widths scale with the (possibly fast-mode / max-candidates
     # clamped) move widths so the latency/batch-size knobs bound them too.
     sw_s = min(cgen.default_num_swap_sources(model), num_sources)
@@ -1054,10 +1085,12 @@ def _goal_step(model: TensorClusterModel, options: OptimizationOptions,
         batches.append(cgen.swap_candidates(
             spec, model, arrays, constraint, options, sw_s, sw_p,
             relevance=relevance, bands=bands, active=active))
+        kind_ids.append(FLIGHT_KINDS.index("swap"))
     if spec.uses_intra_swaps:
         batches.append(cgen.intra_swap_candidates(
             spec, model, arrays, constraint, options, sw_s, sw_p,
             relevance=relevance, bands=bands))
+        kind_ids.append(FLIGHT_KINDS.index("intra_swap"))
     cand = batches[0]
     for extra in batches[1:]:
         cand = cgen.concat_candidates(cand, extra)
@@ -1138,7 +1171,30 @@ def _goal_step(model: TensorClusterModel, options: OptimizationOptions,
             frontier=frontier, compact_k=compact_k,
             repair_oracle=repair_oracle)
     new_model = apply_candidates(model, cand, keep)
-    return new_model, keep.sum(), sel_stats
+    if not flight:
+        return new_model, keep.sum(), sel_stats
+    # Flight-recorder extras: read-only derivations from values the step
+    # already materialized (score/eligible/keep) plus one frontier_active
+    # recomputation for band kinds — the per-step convergence view even in
+    # dense mode.  None of this feeds back into selection.
+    n_kept = keep.sum()
+    off = 0
+    seg_counts = []
+    for b in batches:
+        seg_counts.append(keep[off:off + b.k].sum())
+        off += b.k
+    best_kind = jnp.asarray(kind_ids, jnp.int32)[
+        jnp.argmax(jnp.stack(seg_counts))]
+    kind = jnp.where(n_kept > 0, best_kind, jnp.int32(-1)).astype(jnp.int32)
+    best_score = jnp.max(jnp.where(eligible, score, -jnp.inf))
+    score_bits = jax.lax.bitcast_convert_type(
+        best_score.astype(jnp.float32), jnp.int32)
+    if kernels.is_band_kind(spec):
+        fcount = kernels.frontier_active(
+            spec, model, arrays, constraint).sum().astype(jnp.int32)
+    else:
+        fcount = jnp.int32(-1)
+    return new_model, n_kept, sel_stats, (fcount, score_bits, kind)
 
 
 _step_cache: Dict[tuple, object] = {}
@@ -1327,7 +1383,12 @@ def _build_frontier(active_np: np.ndarray, bucket: int) -> FrontierInvariants:
 # read these, and every entry also lands in the per-goal sensor families
 # (GoalOptimizer.device-fetches / chunks-speculative / chunks-wasted).
 FETCH_COUNTERS = {"device_fetches": 0, "chunks_dispatched": 0,
-                  "chunks_speculative": 0, "chunks_wasted": 0}
+                  "chunks_speculative": 0, "chunks_wasted": 0,
+                  # Bytes of flight-recorder buffers that rode the boundary
+                  # fetches (0 with CRUISE_FLIGHT_RECORDER off) — lets the
+                  # dispatch audit attribute recorder traffic separately
+                  # while proving the fetch COUNT is unchanged.
+                  "flight_bytes": 0}
 
 _gate_fn = None
 
@@ -1346,11 +1407,40 @@ def _get_gate_fn():
     return _gate_fn
 
 
+def _flight_step_dicts(rows, start_step: int, chunk_index: int) -> List[dict]:
+    """Decode executed i32[FLIGHT_WIDTH] recorder rows into timeline dicts.
+
+    ``rows`` must already be sliced to the executed step count (the packed
+    PACKED_STEPS slot); ``start_step`` is the goal-global index of the first
+    row and ``chunk_index`` points at the chunk annotation it belongs to.
+    The best-score slot is bitcast back to f32 (None when no candidate was
+    eligible — the on-device max over an empty set is -inf)."""
+    out = []
+    rows = np.asarray(rows, np.int32)
+    for i, r in enumerate(rows):
+        score = float(np.int32(r[FLIGHT_SCORE_BITS]).view(np.float32))
+        kind = int(r[FLIGHT_KIND])
+        out.append({
+            "step": start_step + i,
+            "chunk": chunk_index,
+            "actions": int(r[FLIGHT_ACTIONS]),
+            "frontier": int(r[FLIGHT_FRONTIER]),
+            "repair": int(r[FLIGHT_REPAIR]),
+            "bisect_depth": int(r[FLIGHT_BISECT]),
+            "lanes_live": int(r[FLIGHT_LANES]),
+            "best_score": score if math.isfinite(score) else None,
+            "kind": FLIGHT_KINDS[kind] if 0 <= kind < len(FLIGHT_KINDS)
+            else None,
+        })
+    return out
+
+
 def _goal_fixpoint_budget(model: TensorClusterModel,
                           options: OptimizationOptions,
                           step_budget, frontier=None, *, spec=None,
                           prev_specs=(), constraint=None, num_sources=None,
-                          num_dests=None, mesh=None, repair_oracle=False):
+                          num_dests=None, mesh=None, repair_oracle=False,
+                          flight_capacity: int = 0):
     """One CHUNK of a goal's fixpoint: identical math to _goal_fixpoint, but
     the step cap is a TRACED scalar and the packed stats come back as one
     i32[PACKED_WIDTH] vector (see state.py for the slot layout) — so every
@@ -1372,7 +1462,15 @@ def _goal_fixpoint_budget(model: TensorClusterModel,
     skips the loop entirely (the while condition is false before the first
     step), which is what makes speculative dispatch free to discard: a
     follow-up chunk whose on-device budget gate collapsed to 0 returns the
-    model bit-unchanged."""
+    model bit-unchanged.
+
+    ``flight_capacity`` (static) > 0 turns on the flight recorder for this
+    trace: the carry grows an i32[flight_capacity, FLIGHT_WIDTH] buffer
+    (see state.py), the body writes one row per executed step, and the
+    return becomes ``(model, packed, active, flight)`` — the buffer rides
+    the same boundary fetch as the packed stats.  Capacity 0 compiles the
+    exact pre-recorder graph and keeps the 3-tuple return."""
+    flight = flight_capacity > 0
     arrays0 = BrokerArrays.from_model(model)
     before = kernels.goal_satisfied(spec, model, arrays0, constraint)
     any_offline = (model.replica_offline_now() & model.replica_valid).any()
@@ -1380,24 +1478,38 @@ def _goal_fixpoint_budget(model: TensorClusterModel,
     inv = compute_step_invariants(spec, prev_specs, model, arrays0, constraint)
 
     def cond(state):
-        _, steps, _, last_n, _rep, _dep, _lan = state
+        steps, last_n = state[1], state[3]
         return (last_n > 0) & (steps < step_budget)
 
     def body(state):
-        m, steps, total, _, rep, dep, lan = state
-        new_m, n, sel = _goal_step(m, options, spec, prev_specs, constraint,
-                                   num_sources, num_dests, mesh,
-                                   invariants=inv, frontier=frontier,
-                                   repair_oracle=repair_oracle)
+        m, steps, total, _, rep, dep, lan = state[:7]
+        out = _goal_step(m, options, spec, prev_specs, constraint,
+                         num_sources, num_dests, mesh,
+                         invariants=inv, frontier=frontier,
+                         repair_oracle=repair_oracle, flight=flight)
+        if flight:
+            new_m, n, sel, extra = out
+        else:
+            new_m, n, sel = out
         n = n.astype(jnp.int32)
-        return (new_m, steps + 1, total + n, n,
-                rep + sel[0], jnp.maximum(dep, sel[2]), lan + sel[1])
+        new_state = (new_m, steps + 1, total + n, n,
+                     rep + sel[0], jnp.maximum(dep, sel[2]), lan + sel[1])
+        if flight:
+            row = jnp.stack([n, extra[0], sel[0], sel[2], sel[1],
+                             extra[1], extra[2]])  # FLIGHT_* slot order
+            buf = state[7].at[
+                jnp.minimum(steps, flight_capacity - 1)].set(row)
+            new_state = new_state + (buf,)
+        return new_state
 
     init = (model, jnp.int32(0), jnp.int32(0),
             jnp.where(skip, jnp.int32(0), jnp.int32(1)),
             jnp.int32(0), jnp.int32(0), jnp.int32(0))
-    (model, steps, total, last_n,
-     rep, dep, lan) = jax.lax.while_loop(cond, body, init)
+    if flight:
+        init = init + (jnp.zeros((flight_capacity, FLIGHT_WIDTH),
+                                 jnp.int32),)
+    final = jax.lax.while_loop(cond, body, init)
+    (model, steps, total, last_n, rep, dep, lan) = final[:7]
     arrays1 = BrokerArrays.from_model(model)
     after = kernels.goal_satisfied(spec, model, arrays1, constraint)
     off_after = (model.replica_offline_now() & model.replica_valid).any()
@@ -1412,6 +1524,8 @@ def _goal_fixpoint_budget(model: TensorClusterModel,
                         after.astype(jnp.int32), capped.astype(jnp.int32),
                         rep, dep, lan, num_active,
                         off_after.astype(jnp.int32)])
+    if flight:
+        return model, packed, active, final[7]
     return model, packed, active
 
 
@@ -1420,16 +1534,18 @@ _budget_cache: Dict[tuple, object] = {}
 
 def _get_budget_fixpoint_fn(spec: GoalSpec, prev_specs: Tuple[GoalSpec, ...],
                             constraint: BalancingConstraint, num_sources: int,
-                            num_dests: int, mesh=None, donate: bool = False):
+                            num_dests: int, mesh=None, donate: bool = False,
+                            flight_capacity: int = 0):
     oracle = _repair_oracle()
     key = (spec, prev_specs, constraint, num_sources, num_dests, mesh, donate,
-           oracle)
+           oracle, flight_capacity)
     fn = _budget_cache.get(key)
     if fn is None:
         fn = jax.jit(partial(_goal_fixpoint_budget, spec=spec,
                              prev_specs=prev_specs, constraint=constraint,
                              num_sources=num_sources, num_dests=num_dests,
-                             mesh=mesh, repair_oracle=oracle),
+                             mesh=mesh, repair_oracle=oracle,
+                             flight_capacity=flight_capacity),
                      donate_argnums=(0,) if donate else ())
         _budget_cache[key] = fn
     return fn
@@ -1490,10 +1606,24 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
     sharded driver uses it for checkpointing.  It disables speculation:
     under donation a speculative dispatch consumes the predecessor model's
     buffers before the callback could read them.
+
+    With ``CRUISE_FLIGHT_RECORDER=1`` every chunk additionally returns an
+    i32[capacity, FLIGHT_WIDTH] per-step buffer that joins the SAME
+    boundary ``device_get`` (the fetch stays ≤1 per boundary; bytes are
+    attributed in ``FETCH_COUNTERS["flight_bytes"]``).  The driver
+    stitches the chunk buffers into ``info["flight"]`` — a per-goal step
+    timeline whose entries point at their chunk record (wall, bucket,
+    length, fresh_compile).  Discarded speculative chunks recorded into
+    their own buffer, which is simply never fetched.
     """
     ns = num_sources or cgen.default_num_sources(model)
     nd = num_dests or cgen.default_num_dests(model)
     B = model.num_brokers
+    # Static per driver call: every chunk length ≤ capacity, so all chunks
+    # of one bucket shape still share ONE executable with the recorder on.
+    flight_cap = min(chunk_steps, max_steps) if _flight_recorder() else 0
+    flight_steps: List[dict] = []
+    flight_chunks: List[dict] = []
     use_frontier = bool(frontier) and kernels.is_band_kind(spec)
     if speculate is None:
         speculate = True
@@ -1532,10 +1662,15 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
         cns, cnd = (ns, nd) if bucket is None else _frontier_widths(bucket,
                                                                     ns, nd)
         fn = _get_budget_fixpoint_fn(spec, prev_specs, constraint, cns, cnd,
-                                     mesh=mesh, donate=donate)
+                                     mesh=mesh, donate=donate,
+                                     flight_capacity=flight_cap)
         size0 = fn._cache_size() if hasattr(fn, "_cache_size") else None
         bud = budget if speculative else jnp.int32(budget)
-        model, packed_d, active_d = fn(model, options, bud, fr)
+        if flight_cap:
+            model, packed_d, active_d, flight_d = fn(model, options, bud, fr)
+        else:
+            model, packed_d, active_d = fn(model, options, bud, fr)
+            flight_d = None
         # A chunk that built (or deserialized) its executable this process
         # carries that one-off wall in wall_s — flag it so the wall-slope
         # flatness metric can exclude it (tools/tail_report.py).
@@ -1546,7 +1681,8 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
             # some process already built this executable (warm disk cache).
             token = _persist_token(
                 "budget", (spec, prev_specs, constraint, cns, cnd, mesh,
-                           donate, bucket), model, options)
+                           donate, bucket)
+                + ((flight_cap,) if flight_cap else ()), model, options)
             if not (token and compile_cache.seen(token)):
                 fresh = True
             if token:
@@ -1555,10 +1691,10 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
         if speculative:
             FETCH_COUNTERS["chunks_speculative"] += 1
             speculated += 1
-        return {"packed": packed_d, "active": active_d, "bucket": bucket,
-                "fr": fr, "ns": cns, "nd": cnd, "blen": blen,
-                "fresh": chunk_fresh, "speculative": speculative,
-                "confirm": confirm}
+        return {"packed": packed_d, "active": active_d, "flight": flight_d,
+                "bucket": bucket, "fr": fr, "ns": cns, "nd": cnd,
+                "blen": blen, "fresh": chunk_fresh,
+                "speculative": speculative, "confirm": confirm}
 
     while steps_done < max_steps:
         if pending is not None:
@@ -1587,12 +1723,20 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
                 pending = _dispatch(cur["bucket"], cur["fr"], gated, nxt,
                                     True)
         t_f = time.monotonic()
+        # ONE blocking transfer per boundary, recorder or not: the flight
+        # buffer (when present) joins the same device_get tuple.
+        targets = [cur["packed"]]
         if use_frontier:
-            packed_np, active_np = jax.device_get((cur["packed"],
-                                                   cur["active"]))
-        else:
-            packed_np = jax.device_get(cur["packed"])
-            active_np = None
+            targets.append(cur["active"])
+        if cur["flight"] is not None:
+            targets.append(cur["flight"])
+        fetched = list(jax.device_get(tuple(targets)))
+        packed_np = fetched.pop(0)
+        active_np = fetched.pop(0) if use_frontier else None
+        flight_np = fetched.pop(0) if cur["flight"] is not None else None
+        if flight_np is not None:
+            FETCH_COUNTERS["flight_bytes"] += int(
+                np.asarray(flight_np).nbytes)
         FETCH_COUNTERS["device_fetches"] += 1
         fetches += 1
         now = time.monotonic()
@@ -1625,6 +1769,13 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
                "fresh_compile": cur["fresh"],
                "speculative": cur["speculative"]}
         chunks.append(rec)
+        if flight_np is not None:
+            ci = len(flight_chunks)
+            flight_steps.extend(_flight_step_dicts(
+                np.asarray(flight_np)[:s], len(flight_steps), ci))
+            flight_chunks.append({"wall_s": wall, "bucket": cur["bucket"],
+                                  "len": s, "fresh_compile": cur["fresh"],
+                                  "speculative": cur["speculative"]})
         if on_chunk is not None:
             on_chunk(model, rec)
         # Adaptive chunk length: grow while hot, halve in the tail.
@@ -1687,6 +1838,9 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
             "lanes_live": lanes_total, "fetches": fetches,
             "fetch_wait_s": fetch_wait, "chunks_speculative": speculated,
             "chunks_wasted": wasted}
+    if flight_cap:
+        info["flight"] = {"kinds": list(FLIGHT_KINDS),
+                          "steps": flight_steps, "chunks": flight_chunks}
     return model, info
 
 
@@ -1722,7 +1876,7 @@ def _stack_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
                     specs: Tuple[GoalSpec, ...], constraint: BalancingConstraint,
                     num_sources: int, num_dests: int, max_steps: int, mesh=None,
                     prev_specs: Tuple[GoalSpec, ...] = (),
-                    repair_oracle: bool = False):
+                    repair_oracle: bool = False, flight_capacity: int = 0):
     """A run of goals in one XLA program: each goal's while_loop runs
     in priority order, prev-goal acceptance masks accumulating exactly as in
     the unfused path.  One dispatch + one host transfer for the whole run —
@@ -1735,20 +1889,34 @@ def _stack_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
     Each goal runs through _goal_fixpoint_budget so the packed result is
     one i32[PACKED_WIDTH, G] matrix (slot layout in state.py) — and the
     grouped path reports the bounded-repair counters just like the per-goal
-    frontier driver does."""
+    frontier driver does.
+
+    ``flight_capacity`` > 0 (static) also stacks each goal's flight
+    buffer into one i32[G, capacity, FLIGHT_WIDTH] block returned as a
+    third output — per-goal step timelines for the whole run in the same
+    single host fetch."""
     packed_l = []
+    flight_l = []
     prev: Tuple[GoalSpec, ...] = tuple(prev_specs)
     for spec in specs:
-        model, packed, _ = _goal_fixpoint_budget(
+        out = _goal_fixpoint_budget(
             model, options, jnp.int32(max_steps), None, spec=spec,
             prev_specs=prev, constraint=constraint,
             num_sources=num_sources, num_dests=num_dests, mesh=mesh,
-            repair_oracle=repair_oracle)
+            repair_oracle=repair_oracle, flight_capacity=flight_capacity)
+        if flight_capacity:
+            model, packed, _, buf = out
+            flight_l.append(buf)
+        else:
+            model, packed, _ = out
         packed_l.append(packed)
         prev = prev + (spec,)
     # One i32[PACKED_WIDTH, G] result matrix: a single host fetch covers the
     # whole run (each device_get round trip costs ~0.5-1 s over a tunneled
     # TPU; separate vectors were separate round trips).
+    if flight_capacity:
+        return (model, jnp.stack(packed_l, axis=1),
+                jnp.stack(flight_l, axis=0))
     return model, jnp.stack(packed_l, axis=1)
 
 
@@ -1792,21 +1960,53 @@ def _push_dispatch_sensors(goal_name: str, fetches: int,
     ).inc(chunks_wasted)
 
 
+def _push_flight_sensors(goal_name: str, flight: dict) -> None:
+    """Flight-recorder convergence-shape sensors (recorder-on runs only):
+    the per-step action distribution and how front-loaded the goal's
+    progress was.  Both fused paths report through here."""
+    steps = flight.get("steps") or []
+    labels = {"goal": goal_name}
+    hist = SENSORS.histogram(
+        "GoalOptimizer.actions-per-step",
+        buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512),
+        labels=labels,
+        help="Accepted actions per fixpoint step (flight recorder)")
+    total = 0
+    for s in steps:
+        hist.observe(s["actions"])
+        total += s["actions"]
+    to90 = 0
+    if total > 0:
+        cum = 0
+        for i, s in enumerate(steps):
+            cum += s["actions"]
+            if cum >= 0.9 * total:
+                to90 = i + 1
+                break
+    SENSORS.gauge(
+        "GoalOptimizer.steps-to-90pct-actions", labels=labels,
+        help="Steps to reach 90% of the goal's accepted actions "
+             "(flight recorder)",
+    ).set(to90)
+
+
 _stack_cache: Dict[tuple, object] = {}
 
 
 def _get_stack_fn(specs: Tuple[GoalSpec, ...], constraint: BalancingConstraint,
                   num_sources: int, num_dests: int, max_steps: int, mesh=None,
-                  prev_specs: Tuple[GoalSpec, ...] = (), donate: bool = False):
+                  prev_specs: Tuple[GoalSpec, ...] = (), donate: bool = False,
+                  flight_capacity: int = 0):
     oracle = _repair_oracle()
     key = (specs, constraint, num_sources, num_dests, max_steps, mesh,
-           prev_specs, donate, oracle)
+           prev_specs, donate, oracle, flight_capacity)
     fn = _stack_cache.get(key)
     if fn is None:
         fn = jax.jit(partial(_stack_fixpoint, specs=specs, constraint=constraint,
                              num_sources=num_sources, num_dests=num_dests,
                              max_steps=max_steps, mesh=mesh,
-                             prev_specs=prev_specs, repair_oracle=oracle),
+                             prev_specs=prev_specs, repair_oracle=oracle,
+                             flight_capacity=flight_capacity),
                      donate_argnums=(0,) if donate else ())
         _stack_cache[key] = fn
     return fn
@@ -1856,6 +2056,10 @@ class GoalResult:
     fetch_wait_s: float = 0.0
     chunks_speculative: int = 0
     chunks_wasted: int = 0
+    # Flight-recorder timeline ({"kinds", "steps", "chunks"} — see
+    # _flight_step_dicts for the per-step schema) when the goal ran with
+    # CRUISE_FLIGHT_RECORDER=1; None with the recorder off.
+    flight: Optional[dict] = None
 
 
 @dataclasses.dataclass
@@ -1942,7 +2146,9 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
                          lanes_live=g.lanes_live,
                          fetches=g.fetches,
                          chunks_speculative=g.chunks_speculative,
-                         chunks_wasted=g.chunks_wasted)
+                         chunks_wasted=g.chunks_wasted,
+                         **({"flight": g.flight}
+                            if g.flight is not None else {}))
         sp.annotate(actions=sum(g.actions_applied for g in run.goal_results),
                     steps=sum(g.steps for g in run.goal_results),
                     candidates_scored=run.num_candidates_scored)
@@ -2171,7 +2377,8 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
                     fetches=info.get("fetches", 0),
                     fetch_wait_s=info.get("fetch_wait_s", 0.0),
                     chunks_speculative=info.get("chunks_speculative", 0),
-                    chunks_wasted=info.get("chunks_wasted", 0)))
+                    chunks_wasted=info.get("chunks_wasted", 0),
+                    flight=info.get("flight")))
                 _push_repair_sensors(spec.name,
                                      info.get("repair_steps", 0),
                                      info.get("bisect_depth", 0),
@@ -2180,6 +2387,8 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
                                        info.get("fetches", 0),
                                        info.get("chunks_speculative", 0),
                                        info.get("chunks_wasted", 0))
+                if info.get("flight") is not None:
+                    _push_flight_sensors(spec.name, info["flight"])
                 if spec.is_hard and not info["satisfied_after"] \
                         and raise_on_hard_failure:
                     raise OptimizationFailureException(
@@ -2188,6 +2397,14 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
                 prev = prev + (spec,)
         else:
             packed_rows = []
+            # Per-goal flight buffers (i32[G, capacity, FLIGHT_WIDTH] per
+            # group chunk) ride the same packed fetch when the recorder is
+            # on; None entries keep the off path fetch-identical.
+            flight_cap = (max(max_steps_per_goal, 1)
+                          if _flight_recorder() else 0)
+            flight_rows: List[np.ndarray] = []
+            group_wall: List[float] = []  # one wall per group chunk
+            group_of: List[int] = []      # goal index -> its chunk's wall
             # Per-goal fresh-compile flags: a _stack_cache miss means the
             # chunk's XLA program is built (compiled on first call) within
             # this run.
@@ -2202,7 +2419,7 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
             # real incremental wall — split evenly across its goals, as
             # before.  The default auto config uses one chunk for small
             # models, where the pipeline degenerates to dispatch + fetch.
-            inflight: List[tuple] = []  # (goal_chunk, packed_d, fresh)
+            inflight: List[tuple] = []  # (goal_chunk, packed_d, flight_d, fresh)
             t_prev = time.monotonic()
             # One blocking fetch per group chunk; attributed to the chunk's
             # lead goal (a group shares its packed fetch, so per-goal split
@@ -2214,9 +2431,19 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
 
             def _drain_one():
                 nonlocal t_prev
-                goal_chunk, packed_d, chunk_fresh = inflight.pop(0)
+                goal_chunk, packed_d, flight_d, chunk_fresh = inflight.pop(0)
                 t_get = time.monotonic()
-                packed_rows.append(np.asarray(jax.device_get(packed_d)))
+                # Still ONE blocking fetch per group chunk: the flight
+                # block (when recording) joins the packed transfer.
+                if flight_d is not None:
+                    packed_np, flight_np = jax.device_get(
+                        (packed_d, flight_d))
+                    flight_np = np.asarray(flight_np)
+                    FETCH_COUNTERS["flight_bytes"] += int(flight_np.nbytes)
+                    flight_rows.append(flight_np)
+                else:
+                    packed_np = jax.device_get(packed_d)
+                packed_rows.append(np.asarray(packed_np))
                 FETCH_COUNTERS["device_fetches"] += 1
                 now = time.monotonic()
                 lead = goal_chunk[0].name
@@ -2227,6 +2454,8 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
                 durations.extend([(now - t_prev) / len(goal_chunk)]
                                  * len(goal_chunk))
                 fresh_v.extend([chunk_fresh] * len(goal_chunk))
+                group_wall.append(now - t_prev)
+                group_of.extend([len(group_wall) - 1] * len(goal_chunk))
                 t_prev = now
 
             for start in range(0, len(specs), group):
@@ -2234,7 +2463,8 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
                 n_cached = len(_stack_cache)
                 stack_fn = _get_stack_fn(chunk, constraint, ns, nd,
                                          max_steps_per_goal, mesh=mesh,
-                                         prev_specs=prev, donate=donate)
+                                         prev_specs=prev, donate=donate,
+                                         flight_capacity=flight_cap)
                 miss = len(_stack_cache) > n_cached
                 # A python-dict miss alone can't tell a cold XLA build from
                 # a warm persistent-cache load after a process restart; the
@@ -2242,14 +2472,19 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
                 # fresh_compile to "no process has built this program yet".
                 token = _persist_token(
                     "stack", (chunk, constraint, ns, nd, max_steps_per_goal,
-                              mesh, prev, donate), model, options) if miss \
-                    else None
+                              mesh, prev, donate)
+                    + ((flight_cap,) if flight_cap else ()), model,
+                    options) if miss else None
                 chunk_fresh = miss and not (token and compile_cache.seen(token))
-                model, packed = stack_fn(model, options)
+                if flight_cap:
+                    model, packed, flight_d = stack_fn(model, options)
+                else:
+                    model, packed = stack_fn(model, options)
+                    flight_d = None
                 if token:
                     compile_cache.mark(token)
                 FETCH_COUNTERS["chunks_dispatched"] += 1
-                inflight.append((chunk, packed, chunk_fresh))
+                inflight.append((chunk, packed, flight_d, chunk_fresh))
                 if len(inflight) > 1:
                     _drain_one()
                 prev = prev + chunk
@@ -2269,8 +2504,26 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
              repair_v, depth_v, lanes_v) = (
                 np.concatenate([row[i] for row in packed_rows])
                 for i in range(8))
+            flight_all = (np.concatenate(flight_rows, axis=0)
+                          if flight_rows else None)
             for i, spec in enumerate(specs):
                 scored += int(steps_v[i]) * k_of(spec)
+                flight = None
+                if flight_all is not None:
+                    # Slice this goal's buffer to ITS executed step count
+                    # (steps_v aligns with the concatenated goal axis) —
+                    # grouped timelines attribute steps per goal, one
+                    # synthetic "chunk" per group program.
+                    flight = {
+                        "kinds": list(FLIGHT_KINDS),
+                        "steps": _flight_step_dicts(
+                            flight_all[i][:int(steps_v[i])], 0, 0),
+                        "chunks": [{"wall_s": group_wall[group_of[i]],
+                                    "bucket": None,
+                                    "len": int(steps_v[i]),
+                                    "fresh_compile": fresh_v[i],
+                                    "speculative": False}],
+                    }
                 results.append(GoalResult(
                     name=spec.name, is_hard=spec.is_hard,
                     satisfied_before=bool(before_v[i]),
@@ -2282,9 +2535,12 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
                     bisect_depth=int(depth_v[i]),
                     lanes_live=int(lanes_v[i]),
                     fetches=fetch_of.get(spec.name, 0),
-                    fetch_wait_s=fetch_wait_of.get(spec.name, 0.0)))
+                    fetch_wait_s=fetch_wait_of.get(spec.name, 0.0),
+                    flight=flight))
                 _push_repair_sensors(spec.name, int(repair_v[i]),
                                      int(depth_v[i]), int(lanes_v[i]))
+                if flight is not None:
+                    _push_flight_sensors(spec.name, flight)
                 if spec.is_hard and not bool(after_v[i]) \
                         and raise_on_hard_failure:
                     raise OptimizationFailureException(

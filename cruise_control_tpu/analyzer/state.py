@@ -130,6 +130,28 @@ PACKED_ANY_OFFLINE = 9    # offline replicas remain at chunk exit (0/1)
 PACKED_WIDTH = 10
 
 
+# ---------------------------------------------------------------------------
+# Flight-recorder per-step row layout
+# ---------------------------------------------------------------------------
+# With ``CRUISE_FLIGHT_RECORDER=1`` the budget fixpoint additionally carries a
+# fixed-size i32[C, FLIGHT_WIDTH] telemetry buffer (C = chunk capacity): the
+# loop body writes one row per executed step, and the buffer piggybacks on the
+# same single boundary fetch as the packed stats — zero extra dispatches, zero
+# extra ``device_get`` calls.  Speculative chunks record into their own buffer
+# and are simply never fetched when the budget gate collapses them.  The
+# f32 best-eligible score is bitcast into the i32 row (FLIGHT_SCORE_BITS);
+# hosts decode it with ``np.int32(...).view(np.float32)``.
+
+FLIGHT_ACTIONS = 0      # candidates accepted this step
+FLIGHT_FRONTIER = 1     # frontier_active population at step entry; -1 non-band
+FLIGHT_REPAIR = 2       # selection repair saw a violation this step (0/1)
+FLIGHT_BISECT = 3       # compiled repair bisection depth this step
+FLIGHT_LANES = 4        # live candidate lanes at compaction this step
+FLIGHT_SCORE_BITS = 5   # best eligible candidate score, f32 bitcast to i32
+FLIGHT_KIND = 6         # argmax action-kind index into FLIGHT_KINDS; -1 none
+FLIGHT_WIDTH = 7
+
+
 @struct.dataclass
 class OptimizationOptions:
     """Traced per-request constraints (analyzer/OptimizationOptions.java:16).

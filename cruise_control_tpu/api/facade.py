@@ -600,6 +600,11 @@ class CruiseControl:
             "AnalyzerState": {
                 "goals": self.goals,
                 "proposalsCached": self._cached is not None,
+                # Whether the NEXT optimization records per-step flight
+                # telemetry (CRUISE_FLIGHT_RECORDER env, possibly seeded
+                # from analyzer.flight.recorder config) — operators check
+                # here before expecting /flight data.
+                "flightRecorder": opt._flight_recorder(),
             },
         }
         if detector_manager is not None:

@@ -47,7 +47,7 @@ PREFIX = "/kafkacruisecontrol"
 
 GET_ENDPOINTS = {"bootstrap", "train", "load", "partition_load", "proposals",
                  "state", "kafka_cluster_state", "user_tasks", "review_board",
-                 "metrics", "trace"}
+                 "metrics", "trace", "flight"}
 POST_ENDPOINTS = {"add_broker", "remove_broker", "fix_offline_replicas",
                   "rebalance", "stop_proposal_execution", "pause_sampling",
                   "resume_sampling", "demote_broker", "admin", "review",
@@ -352,6 +352,46 @@ class CruiseControlApi:
         limit = int(q.get("limit", "20"))
         return 200, {"traces": TRACE.recent(limit),
                      "rollup": TRACE.rollup()}, {}
+
+    def _ep_flight(self, q):
+        """Flight-recorder convergence timelines of a task's optimization:
+        the per-goal per-step telemetry the analyzer attached to its
+        ``analyzer.goal`` spans (CRUISE_FLIGHT_RECORDER=1 runs only).
+        ``?task_id=`` is required; 202 while the task is still ACTIVE."""
+        task_id = q.get("task_id")
+        if not task_id:
+            return 400, {"error": "flight requires ?task_id="}, {}
+        task = self.user_tasks.get(task_id)
+        if task is None:
+            return 404, {"error": f"unknown task_id {task_id!r}"}, {}
+        if task.trace is None:
+            if task.status == TaskStatus.ACTIVE:
+                return 202, {"userTaskId": task.task_id,
+                             "status": task.status,
+                             "message": "trace not finished yet"}, {}
+            return 404, {"error": f"no trace recorded for task "
+                                  f"{task_id!r}"}, {}
+        goals = []
+
+        def walk(span):
+            attrs = span.get("attrs") or {}
+            if span.get("name") == "analyzer.goal" and "flight" in attrs:
+                goals.append({"goal": attrs.get("goal"),
+                              "steps": attrs.get("steps"),
+                              "actions": attrs.get("actions"),
+                              "durationMs": span.get("durationMs"),
+                              "flight": attrs["flight"]})
+            for c in span.get("children") or []:
+                walk(c)
+
+        walk(task.trace)
+        if not goals:
+            return 404, {"error": "no flight data on this task's trace — "
+                                  "was CRUISE_FLIGHT_RECORDER=1 (or "
+                                  "analyzer.flight.recorder) set when the "
+                                  "task ran?"}, {}
+        return 200, {"userTaskId": task.task_id, "status": task.status,
+                     "goals": goals}, {}
 
     def _ep_load(self, q):
         def fn(progress):

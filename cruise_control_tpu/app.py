@@ -77,6 +77,11 @@ class KafkaCruiseControlApp:
         ceiling = self.config.get(C.TPU_COMPILE_CEILING_CONFIG)
         if ceiling and "CRUISE_TPU_COMPILE_CEILING" not in os.environ:
             os.environ["CRUISE_TPU_COMPILE_CEILING"] = ceiling
+        # Same pattern for the solve flight recorder: the optimizer keys its
+        # jit caches on the env flag, so config only seeds an unset env.
+        if self.config.get(C.ANALYZER_FLIGHT_RECORDER_CONFIG) \
+                and "CRUISE_FLIGHT_RECORDER" not in os.environ:
+            os.environ["CRUISE_FLIGHT_RECORDER"] = "1"
 
         from cruise_control_tpu.api.facade import CruiseControl
         from cruise_control_tpu.api.server import (BasicSecurityProvider,

@@ -50,6 +50,7 @@ OVERPROVISIONED_MIN_EXTRA_RACKS_CONFIG = "overprovisioned.min.extra.racks"
 COMPILE_CACHE_DIR_CONFIG = "compile.cache.dir"
 COMPILE_CACHE_WARMUP_CONFIG = "compile.cache.warmup"
 TPU_COMPILE_CEILING_CONFIG = "tpu.compile.ceiling"
+ANALYZER_FLIGHT_RECORDER_CONFIG = "analyzer.flight.recorder"
 
 DEFAULT_GOAL_NAMES = [
     "RackAwareGoal",
@@ -176,6 +177,15 @@ def analyzer_config_def() -> ConfigDef:
                  "on wide programs), an integer imposes that cap on any backend. "
                  "Clamps are counted by GoalOptimizer.compile-ceiling-clamps.",
              group="analyzer")
+    d.define(ANALYZER_FLIGHT_RECORDER_CONFIG, Type.BOOLEAN, False, importance=Importance.LOW,
+             doc="Enable the solve flight recorder (propagated to the "
+                 "CRUISE_FLIGHT_RECORDER env var): every optimizer chunk returns "
+                 "a per-step telemetry buffer (actions, frontier size, repair "
+                 "activity, best score, action kind) piggybacked on its existing "
+                 "boundary fetch — zero extra dispatches or host round trips.  "
+                 "Surfaced via GET /flight, analyzer.goal trace spans, and the "
+                 "GoalOptimizer.actions-per-step / steps-to-90pct-actions "
+                 "sensors.", group="analyzer")
     return d
 
 

@@ -130,7 +130,14 @@ def distributed_frontier_fixpoint(model: TensorClusterModel, spec: GoalSpec,
     sharded step does.  An ``on_chunk`` checkpoint callback disables
     speculation (the callback must observe every intermediate model before
     the next dispatch may consume its buffers); ``speculate`` forces it
-    off/on otherwise.  Returns ``(model, info)`` — see frontier_fixpoint."""
+    off/on otherwise.  Returns ``(model, info)`` — see frontier_fixpoint.
+
+    With ``CRUISE_FLIGHT_RECORDER=1`` each sharded chunk carries the
+    i32[C, FLIGHT_WIDTH] flight buffer too (GSPMD replicates it — it is a
+    tiny reduction output, not a sharded batch axis) and ``info["flight"]``
+    holds the stitched per-step timeline, same as the single-device
+    driver: the buffer rides the existing boundary fetch, so the sharded
+    path keeps its ≤1-blocking-fetch-per-boundary budget unchanged."""
     from cruise_control_tpu.analyzer.optimizer import frontier_fixpoint
     return frontier_fixpoint(model, options, spec, prev_specs, constraint,
                              num_sources=num_sources, num_dests=num_dests,

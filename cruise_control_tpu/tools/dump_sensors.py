@@ -1,20 +1,37 @@
-"""Dump the sensor catalog as a markdown table.
+"""Dump the sensor catalog as a markdown table; check the docs against it.
 
-Usage: python -m cruise_control_tpu.tools.dump_sensors [--prometheus]
+Usage: python -m cruise_control_tpu.tools.dump_sensors
+           [--prometheus | --check-docs]
 
 Boots an in-memory stack (synthetic metadata + sampler, no network, no
 accelerator requirements beyond what the analyzer already needs), exercises
-the API endpoints so every lazily-registered sensor family exists, then
-prints the registry catalog sorted by name.  The table is what
-docs/OBSERVABILITY.md's catalog section is generated from — re-run and diff
-after adding sensors.
+the API endpoints so every lazily-registered sensor family exists — one
+rebalance runs with CRUISE_FLIGHT_RECORDER=1 so the flight-recorder
+families register too — then prints the registry catalog sorted by name.
+The table is what docs/OBSERVABILITY.md's catalog section is generated
+from.
 
 With --prometheus, prints the full /metrics exposition instead.
+
+With --check-docs, diffs the live catalog against the table in
+docs/OBSERVABILITY.md and exits non-zero on drift, both directions: a
+sensor added without a docs row, a docs row whose sensor is gone, or help
+text that no longer matches the code.  Families that only register under
+special conditions (``GoalOptimizer.compile-ceiling-clamps`` needs the
+compile ceiling to actually clamp; ``AnomalyDetector.<Class>-rate`` needs
+a handled anomaly) are documented in prose below the table, not as rows —
+the check compares exactly what this deterministic exercise registers.
+Run by tests/test_sensor_docs.py, so the docs cannot drift silently.
 """
 
 from __future__ import annotations
 
+import difflib
+import os
 import sys
+
+DOCS_PATH = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "docs", "OBSERVABILITY.md")
 
 
 def build_stack():
@@ -71,7 +88,9 @@ def exercise(api, mgr) -> None:
     """Hit enough endpoints that every sensor family registers.  The
     non-dryrun rebalance drives the executor phases (in-memory admin, so it
     completes in milliseconds); the detector tick registers the per-detector
-    duration histogram."""
+    duration histogram.  One dryrun rebalance runs with the flight recorder
+    forced on (distinct query so it cannot join an earlier task) so the
+    recorder's convergence sensors register; the env var is restored after."""
     for method, endpoint, query in [
         ("GET", "state", {}),
         ("GET", "load", {}),
@@ -85,6 +104,19 @@ def exercise(api, mgr) -> None:
         status, _, _ = api.handle(method, endpoint, query)
         if status >= 400:
             print(f"warning: {method} /{endpoint} -> {status}", file=sys.stderr)
+    saved = os.environ.get("CRUISE_FLIGHT_RECORDER")
+    os.environ["CRUISE_FLIGHT_RECORDER"] = "1"
+    try:
+        status, _, _ = api.handle(
+            "POST", "rebalance", {"dryrun": "true", "max_wait_s": "301"})
+        if status >= 400:
+            print(f"warning: recorder-on rebalance -> {status}",
+                  file=sys.stderr)
+    finally:
+        if saved is None:
+            os.environ.pop("CRUISE_FLIGHT_RECORDER", None)
+        else:
+            os.environ["CRUISE_FLIGHT_RECORDER"] = saved
     mgr.run_detectors_once(now_ms=1)
 
 
@@ -98,6 +130,45 @@ def catalog_markdown(catalog) -> str:
     return "\n".join(lines)
 
 
+def docs_table_rows(docs_path: str = DOCS_PATH) -> list:
+    """The catalog table rows (``| `sensor` | ...``) from the docs, in file
+    order.  Only the first markdown table in the file is the catalog."""
+    rows, in_table = [], False
+    with open(docs_path) as f:
+        for line in f:
+            line = line.rstrip("\n")
+            if line.startswith("| sensor |"):
+                in_table = True
+                continue
+            if in_table:
+                if line.startswith("|---"):
+                    continue
+                if not line.startswith("| `"):
+                    break
+                rows.append(line)
+    return rows
+
+
+def check_docs(catalog, docs_path: str = DOCS_PATH) -> int:
+    """Diff the live (exercised) catalog against the docs table.  Returns 0
+    when they match row-for-row, 1 with a unified diff on drift."""
+    live = catalog_markdown(catalog).splitlines()[2:]
+    docs = docs_table_rows(docs_path)
+    if live == docs:
+        print(f"docs catalog table matches the live registry "
+              f"({len(live)} sensors)")
+        return 0
+    diff = difflib.unified_diff(docs, live, fromfile="docs/OBSERVABILITY.md",
+                                tofile="live registry", lineterm="")
+    print("sensor catalog drift between docs/OBSERVABILITY.md and the live "
+          "registry — regenerate the table with\n"
+          "  python -m cruise_control_tpu.tools.dump_sensors\n",
+          file=sys.stderr)
+    for line in diff:
+        print(line, file=sys.stderr)
+    return 1
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     from cruise_control_tpu.common.sensors import SENSORS
@@ -106,6 +177,8 @@ def main(argv=None) -> int:
     exercise(api, mgr)
     if "--prometheus" in argv:
         print(SENSORS.prometheus_text(), end="")
+    elif "--check-docs" in argv:
+        return check_docs(SENSORS.catalog())
     else:
         print(catalog_markdown(SENSORS.catalog()))
     return 0

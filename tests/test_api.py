@@ -63,9 +63,11 @@ def build_stack(num_brokers=5, two_step=False, security=None, broker_ids=None):
 def test_endpoint_inventory():
     # The reference exposes exactly 20 endpoints (CruiseControlEndPoint.java);
     # this build adds /metrics (the JMX-sensors surface has to live somewhere
-    # HTTP-reachable in a JVM-free service) and /trace (span traces of admin
-    # operations, keyed by user task).
-    assert len(GET_ENDPOINTS - {"metrics", "trace"}) + len(POST_ENDPOINTS) == 20
+    # HTTP-reachable in a JVM-free service), /trace (span traces of admin
+    # operations, keyed by user task), and /flight (the solve flight
+    # recorder's per-step convergence timelines, cut from those traces).
+    assert len(GET_ENDPOINTS - {"metrics", "trace", "flight"}) \
+        + len(POST_ENDPOINTS) == 20
 
 
 def test_state_endpoint():

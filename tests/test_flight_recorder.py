@@ -1,0 +1,170 @@
+"""Solve flight recorder: correctness of the per-step telemetry.
+
+The recorder is opt-in observability riding the fixpoint carry, so the
+bar is strict: recorder-on proposals are bit-identical to recorder-off
+(including under speculative dispatch — the flag is part of the compile
+cache key, and capacity 0 compiles the exact pre-recorder graph), the
+stitched timeline covers every executed step, per-step action counts sum
+to the packed chunk totals the host already trusted, grouped-stack runs
+attribute steps to the right goal, and the whole thing is reachable over
+HTTP via ``GET /flight?task_id=``.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import jax.numpy as jnp
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from cruise_control_tpu.analyzer import optimizer as opt  # noqa: E402
+from cruise_control_tpu.analyzer.balancing_constraint import (  # noqa: E402
+    BalancingConstraint,
+)
+from cruise_control_tpu.analyzer.goals.specs import goals_by_priority  # noqa: E402
+from cruise_control_tpu.analyzer.state import OptimizationOptions  # noqa: E402
+
+from tests.test_frontier import _skewed_model  # noqa: E402
+
+GOAL = "ReplicaDistributionGoal"
+STACK = ["RackAwareGoal", "ReplicaDistributionGoal",
+         "LeaderReplicaDistributionGoal"]
+# Dense, speculation-friendly driver shape (mirrors
+# test_speculative_dispatch_is_bit_identical): frontier=False keeps every
+# chunk in one bucket so the follow-up chunk dispatches speculatively.
+KW = dict(num_sources=4, num_dests=1, max_steps=64, chunk_steps=8,
+          min_chunk=1, frontier=False)
+
+
+def _run(model, recorder, monkeypatch, **over):
+    if recorder:
+        monkeypatch.setenv("CRUISE_FLIGHT_RECORDER", "1")
+    else:
+        monkeypatch.delenv("CRUISE_FLIGHT_RECORDER", raising=False)
+    con = BalancingConstraint.default()
+    g = goals_by_priority([GOAL])[0]
+    options = OptimizationOptions.none(model)
+    return opt.frontier_fixpoint(model, options, g, (), con, speculate=True,
+                                 **{**KW, **over})
+
+
+def test_recorder_on_is_bit_identical_incl_speculation(monkeypatch):
+    """Flipping the recorder changes telemetry, never the solve: same
+    steps/actions, bit-equal converged model, identical speculation and
+    fetch economy — ON versus OFF on the same skewed model."""
+    model = _skewed_model(seed=3)
+    m_on, i_on = _run(model, True, monkeypatch)
+    m_off, i_off = _run(model, False, monkeypatch)
+
+    assert (i_on["steps"], i_on["actions"]) == (i_off["steps"],
+                                                i_off["actions"])
+    assert bool(jnp.all(m_on.replica_broker == m_off.replica_broker))
+    assert bool(jnp.all(m_on.replica_is_leader == m_off.replica_is_leader))
+    # Recording must not change the dispatch economy either: speculation
+    # still fires and the fetch count stays equal.
+    assert i_on["chunks_speculative"] > 0
+    assert i_on["chunks_speculative"] == i_off["chunks_speculative"]
+    assert i_on["chunks_wasted"] == i_off["chunks_wasted"]
+    assert i_on["fetches"] == i_off["fetches"]
+    assert "flight" in i_on and "flight" not in i_off
+
+
+def test_timeline_covers_every_step_and_sums_to_packed_totals(monkeypatch):
+    """The stitched timeline is complete (one row per executed step, steps
+    numbered contiguously) and consistent with the packed stats the driver
+    already fetched: per-chunk action sums equal each chunk's packed
+    actions total, and only fetched chunks appear (a wasted speculative
+    chunk's buffer is never fetched, so it cannot leak rows)."""
+    model = _skewed_model(seed=3)
+    _, info = _run(model, True, monkeypatch)
+    fl = info["flight"]
+    steps = fl["steps"]
+    assert len(steps) == info["steps"]
+    assert [s["step"] for s in steps] == list(range(len(steps)))
+    assert len(fl["chunks"]) == len(info["chunks"]) == info["fetches"]
+    for ci, chunk in enumerate(info["chunks"]):
+        rows = [s for s in steps if s["chunk"] == ci]
+        assert len(rows) == chunk["steps"] == fl["chunks"][ci]["len"]
+        assert sum(s["actions"] for s in rows) == chunk["actions"]
+    assert sum(s["actions"] for s in steps) == info["actions"]
+    # Schema sanity on a row that accepted actions: a real kind from the
+    # legend, a finite score, non-negative telemetry.
+    active = [s for s in steps if s["actions"] > 0]
+    assert active, "solve accepted no actions — fixture regressed"
+    for s in active:
+        assert s["kind"] in fl["kinds"]
+        assert s["best_score"] is not None
+        assert s["lanes_live"] >= 0 and s["bisect_depth"] >= 0
+
+
+def test_grouped_stack_attributes_steps_to_the_right_goal(monkeypatch):
+    """The grouped stack programs record one buffer per goal; each goal's
+    timeline length and action sum must match its own packed row, and the
+    grouped run's proposals stay bit-identical to recorder-off."""
+    monkeypatch.setenv("CRUISE_FLIGHT_RECORDER", "1")
+    model = _skewed_model(seed=5)
+    run_on = opt.optimize(model, STACK, raise_on_hard_failure=False,
+                          fused=True)
+    monkeypatch.delenv("CRUISE_FLIGHT_RECORDER")
+    run_off = opt.optimize(model, STACK, raise_on_hard_failure=False,
+                           fused=True)
+
+    assert bool(jnp.all(run_on.model.replica_broker
+                        == run_off.model.replica_broker))
+    assert bool(jnp.all(run_on.model.replica_is_leader
+                        == run_off.model.replica_is_leader))
+    by_name_off = {g.name: g for g in run_off.goal_results}
+    saw_steps = False
+    for g in run_on.goal_results:
+        off = by_name_off[g.name]
+        assert (g.steps, g.actions_applied) == (off.steps,
+                                                off.actions_applied)
+        assert off.flight is None
+        if g.steps == 0:
+            continue
+        saw_steps = True
+        assert g.flight is not None, f"{g.name} ran {g.steps} steps unrecorded"
+        steps = g.flight["steps"]
+        assert len(steps) == g.steps
+        assert sum(s["actions"] for s in steps) == g.actions_applied
+    assert saw_steps, "no goal took a step — fixture regressed"
+
+
+@pytest.mark.parametrize("recorder", [False, True],
+                         ids=["recorder-off", "recorder-on"])
+def test_flight_endpoint_round_trip(monkeypatch, recorder):
+    """POST /rebalance then GET /flight?task_id=: 200 with per-goal
+    timelines when the task ran with the recorder on, 404 with a hint when
+    it ran with the recorder off, plus the 400/404 parameter errors."""
+    from tests.test_api import build_stack
+
+    if recorder:
+        monkeypatch.setenv("CRUISE_FLIGHT_RECORDER", "1")
+    else:
+        monkeypatch.delenv("CRUISE_FLIGHT_RECORDER", raising=False)
+    api, _, _ = build_stack()
+    s, _, headers = api.handle("POST", "rebalance",
+                               {"dryrun": "true", "max_wait_s": "300"})
+    assert s == 200
+    task_id = headers["User-Task-ID"]
+
+    s, body, _ = api.handle("GET", "flight", {})
+    assert s == 400
+    s, body, _ = api.handle("GET", "flight", {"task_id": "nope"})
+    assert s == 404
+
+    s, body, _ = api.handle("GET", "flight", {"task_id": task_id})
+    if not recorder:
+        assert s == 404
+        assert "CRUISE_FLIGHT_RECORDER" in body["error"]
+        return
+    assert s == 200
+    assert body["userTaskId"] == task_id
+    assert body["goals"], "recorder-on rebalance exposed no flight goals"
+    for g in body["goals"]:
+        fl = g["flight"]
+        assert len(fl["steps"]) == g["steps"]
+        assert sum(st["actions"] for st in fl["steps"]) == g["actions"]

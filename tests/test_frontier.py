@@ -239,10 +239,19 @@ def test_speculative_dispatch_is_bit_identical():
     assert len(i1["chunks"]) == i1["fetches"]
 
 
-def test_fetch_budget_one_per_chunk_boundary():
+@pytest.mark.parametrize("recorder", [False, True],
+                         ids=["recorder-off", "recorder-on"])
+def test_fetch_budget_one_per_chunk_boundary(monkeypatch, recorder):
     """Pinned round-trip budget: the driver issues exactly ONE device_get
     per fetched chunk boundary — the frontier mask and all boundary stats
-    ride the chunk's own outputs, and there is no separate mask probe."""
+    ride the chunk's own outputs, and there is no separate mask probe.
+    The flight recorder must not change the budget: its buffer joins the
+    boundary fetch tuple (flight_bytes counts the rode-along traffic), it
+    never adds a fetch."""
+    if recorder:
+        monkeypatch.setenv("CRUISE_FLIGHT_RECORDER", "1")
+    else:
+        monkeypatch.delenv("CRUISE_FLIGHT_RECORDER", raising=False)
     model = _skewed_model(seed=9)
     con = BalancingConstraint.default()
     g = goals_by_priority([GOAL])[0]
@@ -259,6 +268,12 @@ def test_fetch_budget_one_per_chunk_boundary():
         assert (d["chunks_dispatched"]
                 == len(info["chunks"]) + info["chunks_wasted"])
         assert info["fetch_wait_s"] >= 0.0
+        if recorder:
+            assert d["flight_bytes"] > 0
+            assert len(info["flight"]["steps"]) == info["steps"]
+        else:
+            assert d["flight_bytes"] == 0
+            assert "flight" not in info
 
 
 def test_fused_sweep_skips_satisfied_goals_and_durations_are_real():

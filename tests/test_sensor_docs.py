@@ -1,0 +1,28 @@
+"""The docs/OBSERVABILITY.md sensor catalog must match the live registry.
+
+Runs the same deterministic stack + exercise dump_sensors uses (including
+the recorder-on rebalance that registers the flight-recorder families) and
+fails with the unified diff on any drift — a sensor added, renamed, or
+re-helped without regenerating the docs table.  Own module so the
+module-scoped registry reset guarantees a clean catalog regardless of what
+other test modules registered first.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from cruise_control_tpu.common.sensors import SENSORS  # noqa: E402
+from cruise_control_tpu.tools import dump_sensors  # noqa: E402
+
+
+def test_sensor_catalog_docs_in_sync(capsys):
+    api, mgr = dump_sensors.build_stack()
+    dump_sensors.exercise(api, mgr)
+    rc = dump_sensors.check_docs(SENSORS.catalog())
+    err = capsys.readouterr().err
+    assert rc == 0, (
+        "docs/OBSERVABILITY.md sensor catalog drifted from the live "
+        "registry — regenerate the table with "
+        "`python -m cruise_control_tpu.tools.dump_sensors`:\n" + err)

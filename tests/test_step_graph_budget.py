@@ -18,7 +18,7 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from tools.step_graph_report import report  # noqa: E402
+from tools.step_graph_report import flight_overhead_report, report  # noqa: E402
 
 # Current body count is 2601 (was 1921 pre-bounded-repair: the fixed-depth
 # bisection + subset-closed safe admit run every step instead of hiding a
@@ -33,6 +33,13 @@ OUTER_EQUATION_CEILING = 700
 # The bounded repair's bisection scans — currently 175 equations of the
 # body; attribution is pinned so repair growth is visible separately.
 REPAIR_EQUATION_CEILING = 260
+# The flight recorder (CRUISE_FLIGHT_RECORDER=1) adds per-step telemetry
+# rows to the budget fixpoint's carry — currently 155 body equations and 1
+# outer equation on top of the recorder-off graph.  Opt-in telemetry gets
+# its own lid so it cannot quietly turn into a second hot path; the
+# recorder-OFF trace is asserted identical-cost to the pre-recorder graph.
+FLIGHT_BODY_OVERHEAD_CEILING = 200
+FLIGHT_OUTER_OVERHEAD_CEILING = 10
 
 
 def test_step_graph_body_within_budget():
@@ -56,3 +63,23 @@ def test_step_graph_body_within_budget():
         "a data-dependent lax.while_loop crept back into the step body")
     assert rec["body_cond_primitives"] == 0, (
         "a branch-divergent lax.cond crept back into the step body")
+
+
+def test_flight_recorder_overhead_within_budget():
+    rec = flight_overhead_report(goal="ReplicaDistributionGoal", brokers=8,
+                                 racks=4, topics=6, mean_ppt=12.0, rf=3,
+                                 capacity=16)
+    # Recorder OFF compiles the same-size body as the plain budget fixpoint:
+    # flight_capacity=0 must cost nothing (the ceiling above covers it too).
+    assert rec["body_equations_off"] <= BODY_EQUATION_CEILING, (
+        f"recorder-off budget fixpoint body is {rec['body_equations_off']} "
+        f"equations (ceiling {BODY_EQUATION_CEILING}) — the capacity-0 path "
+        f"must compile the pre-recorder graph")
+    assert rec["body_overhead"] <= FLIGHT_BODY_OVERHEAD_CEILING, (
+        f"flight recorder adds {rec['body_overhead']} body equations "
+        f"(ceiling {FLIGHT_BODY_OVERHEAD_CEILING}).  The recorder budget is "
+        f"one row-build + one buffer scatter per step; anything beyond that "
+        f"belongs behind its own flag or in the host-side stitcher.")
+    assert rec["outer_overhead"] <= FLIGHT_OUTER_OVERHEAD_CEILING, (
+        f"flight recorder adds {rec['outer_overhead']} prelude equations "
+        f"(ceiling {FLIGHT_OUTER_OVERHEAD_CEILING})")

@@ -128,11 +128,13 @@ def run_audit(rung: str) -> dict:
 
     # FETCH_COUNTERS landed with the async driver; running this audit
     # against an older revision (the whole point of an independent counter)
-    # must still work, with driver attribution reading 0.
+    # must still work, with driver attribution reading 0.  flight_bytes
+    # (recorder buffer traffic riding the boundary fetches) joined later —
+    # .get() keeps pre-recorder revisions auditable too.
     zeros = {"device_fetches": 0, "chunks_dispatched": 0,
-             "chunks_speculative": 0, "chunks_wasted": 0}
+             "chunks_speculative": 0, "chunks_wasted": 0, "flight_bytes": 0}
     counters = getattr(opt, "FETCH_COUNTERS", zeros)
-    before = dict(counters)
+    before = {k: counters.get(k, 0) for k in zeros}
     jax.device_get = counting_get
     try:
         t0 = time.monotonic()
@@ -142,7 +144,7 @@ def run_audit(rung: str) -> dict:
         wall = time.monotonic() - t0
     finally:
         jax.device_get = real_get
-    driver = {k: counters[k] - before[k] for k in before}
+    driver = {k: counters.get(k, 0) - before[k] for k in before}
     boundaries = sum(len(g.chunks or []) for g in run.goal_results) or driver[
         "device_fetches"]
     return {
@@ -155,6 +157,15 @@ def run_audit(rung: str) -> dict:
         "chunks_dispatched": driver["chunks_dispatched"],
         "chunks_speculative": driver["chunks_speculative"],
         "chunks_wasted": driver["chunks_wasted"],
+        # Flight-recorder attribution: ON/OFF state, extra bytes that rode
+        # the boundary fetches, and the recorder's extra fetches — pinned
+        # at 0 by construction (the buffer joins the existing device_get
+        # tuple), which this audit proves rather than assumes: the
+        # fetches_per_boundary number below is measured with the wrapper,
+        # not read from driver bookkeeping.
+        "flight_recorder": os.environ.get(
+            "CRUISE_FLIGHT_RECORDER", "").strip() == "1",
+        "flight_bytes": driver["flight_bytes"],
         "chunk_boundaries": boundaries,
         "fetches_per_boundary": round(
             driver["device_fetches"] / max(boundaries, 1), 3),

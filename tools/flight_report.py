@@ -1,0 +1,206 @@
+"""Render flight-recorder convergence curves; write FLIGHT_<rung> artifacts.
+
+The solve flight recorder (CRUISE_FLIGHT_RECORDER=1) gives every optimized
+goal a per-step timeline — actions accepted, frontier population, repair
+activity, best eligible score, dominant action kind — stitched from the
+i32[C, FLIGHT_WIDTH] buffers that piggyback on each chunk's single boundary
+fetch.  This tool turns those timelines into something a human (ASCII
+curves) or a later revision (FLIGHT_<rung>.json) can read:
+
+- ``python tools/flight_report.py FLIGHT_mid.json``          render an artifact
+- ``python tools/flight_report.py BENCH_mid.json``           render a bench
+  record whose per_goal blocks carry ``flight`` (bench.py --flight)
+- ``python tools/flight_report.py --run mid``                run the rung live
+  with the recorder on and render it (writes FLIGHT_<rung>.json with -o)
+- ``--json`` emits the report as one JSON line instead of the curves.
+
+The per-step schema is optimizer._flight_step_dicts'; the artifact pins
+``timeline_complete`` (every executed step has a recorded row) because that
+is the recorder's acceptance bar.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_BAR_W = 40
+
+
+def goal_flights(record: dict) -> dict:
+    """``{goal: {steps, actions, wall_s, flight}}`` from either an artifact
+    (``goals`` block) or a bench record (``per_goal`` with flight)."""
+    if "goals" in record and "per_goal" not in record:
+        return {name: dict(g) for name, g in record["goals"].items()
+                if g.get("flight")}
+    out = {}
+    for name, g in record.get("per_goal", {}).items():
+        if g.get("flight"):
+            out[name] = {"steps": int(g.get("steps", 0)),
+                         "actions": int(g.get("actions", 0)),
+                         "wall_s": float(g.get("wall_s", 0.0)),
+                         "flight": g["flight"]}
+    return out
+
+
+def steps_to_90pct(steps: list) -> int:
+    """Steps to reach 90% of the total accepted actions (0 when none)."""
+    total = sum(s["actions"] for s in steps)
+    if total <= 0:
+        return 0
+    cum = 0
+    for i, s in enumerate(steps):
+        cum += s["actions"]
+        if cum >= 0.9 * total:
+            return i + 1
+    return len(steps)
+
+
+def build_report(record: dict) -> dict:
+    goals = goal_flights(record)
+    rep_goals = {}
+    for name, g in goals.items():
+        steps = g["flight"].get("steps", [])
+        chunks = g["flight"].get("chunks", [])
+        declared = int(g.get("steps", len(steps)))
+        rep_goals[name] = {
+            "steps": declared,
+            "actions": int(g.get("actions", 0)),
+            "wall_s": float(g.get("wall_s", 0.0)),
+            "recorded_steps": len(steps),
+            "timeline_complete": len(steps) == declared,
+            "steps_to_90pct_actions": steps_to_90pct(steps),
+            "chunks": len(chunks),
+            "fresh_compile_chunks": sum(
+                1 for c in chunks if c.get("fresh_compile")),
+            "flight": g["flight"],
+        }
+    return {
+        "metric": "flight_report",
+        "source_metric": record.get("metric"),
+        "backend": record.get("backend"),
+        "goals": rep_goals,
+        "timeline_complete": all(g["timeline_complete"]
+                                 for g in rep_goals.values()) if rep_goals
+        else False,
+    }
+
+
+def write_artifact(record: dict, path: str) -> dict:
+    """Distill a bench record (or live run record) into a FLIGHT artifact
+    and write it; returns the artifact dict."""
+    rep = build_report(record)
+    rung = os.path.basename(path).replace("FLIGHT_", "").replace(".json", "")
+    art = dict(rep)
+    art["metric"] = f"flight_{rung}"
+    with open(path, "w") as f:
+        json.dump(art, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return art
+
+
+def _bar(v: int, vmax: int) -> str:
+    if vmax <= 0:
+        return ""
+    return "#" * max(1 if v > 0 else 0, round(_BAR_W * v / vmax))
+
+
+def print_curves(rep: dict) -> None:
+    if not rep["goals"]:
+        print("no flight data (was the run recorded with "
+              "CRUISE_FLIGHT_RECORDER=1 / bench.py --flight?)")
+        return
+    for name, g in sorted(rep["goals"].items()):
+        flag = "" if g["timeline_complete"] else "  INCOMPLETE-TIMELINE"
+        print(f"{name}  steps={g['steps']} actions={g['actions']} "
+              f"wall={g['wall_s']:.3f}s chunks={g['chunks']} "
+              f"to90%={g['steps_to_90pct_actions']}{flag}")
+        steps = g["flight"].get("steps", [])
+        vmax = max((s["actions"] for s in steps), default=0)
+        for s in steps:
+            score = s.get("best_score")
+            score_s = "-" if score is None else f"{score:.3g}"
+            frontier = s.get("frontier", -1)
+            fr_s = "-" if frontier < 0 else str(frontier)
+            print(f"  {s['step']:>4} {s['actions']:>6} "
+                  f"{_bar(s['actions'], vmax):<{_BAR_W}} "
+                  f"fr={fr_s:<5} kind={s.get('kind') or '-':<10} "
+                  f"score={score_s} rep={s.get('repair', 0)}")
+        print()
+    print(f"timeline_complete: {rep['timeline_complete']}")
+
+
+def run_live(rung: str) -> dict:
+    """Run one bench rung with the recorder forced on; returns a bench-shaped
+    record whose per_goal blocks carry flight timelines."""
+    os.environ["CRUISE_FLIGHT_RECORDER"] = "1"
+    import jax
+
+    import bench
+    from cruise_control_tpu.analyzer import optimizer as opt
+    from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster
+
+    brokers, racks, topics, ppt, rf = bench.SCALES[rung]
+    spec = ClusterSpec(num_brokers=brokers, num_racks=racks,
+                       num_topics=topics, mean_partitions_per_topic=ppt,
+                       replication_factor=rf, distribution="exponential",
+                       seed=2026)
+    model = jax.device_put(generate_cluster(spec))
+    jax.block_until_ready(model)
+    run = opt.optimize(opt.donation_copy(model), bench.STACK,
+                       raise_on_hard_failure=False, fused=True,
+                       donate_model=True)
+    return {
+        "metric": f"flight_live_{rung}",
+        "backend": jax.devices()[0].platform,
+        "per_goal": {g.name: {
+            "steps": g.steps, "actions": g.actions_applied,
+            "wall_s": round(g.duration_s, 3),
+            **({"flight": g.flight} if g.flight is not None else {}),
+        } for g in run.goal_results},
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("record", nargs="?",
+                    help="FLIGHT_*.json artifact or bench record with "
+                         "flight blocks")
+    ap.add_argument("--run", metavar="RUNG",
+                    help="run this bench rung live with the recorder on")
+    ap.add_argument("-o", "--out",
+                    help="also write the FLIGHT artifact to this path")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as one JSON line (no curves)")
+    args = ap.parse_args()
+    if args.run:
+        record = run_live(args.run)
+    elif args.record:
+        with open(args.record) as f:
+            text = f.read().strip()
+        try:
+            # FLIGHT artifacts are one indented JSON document …
+            record = json.loads(text)
+        except json.JSONDecodeError:
+            # … bench output is .jsonl (one record per line, last wins).
+            record = json.loads(text.splitlines()[-1])
+        if "per_goal" not in record and "goals" not in record \
+                and "rungs" in record:
+            record = record["rungs"][-1]
+    else:
+        ap.error("need an artifact/bench record path (or --run RUNG)")
+    rep = build_report(record)
+    if args.out:
+        write_artifact(record, args.out)
+    if args.json:
+        print(json.dumps(rep), flush=True)
+    else:
+        print_curves(rep)
+
+
+if __name__ == "__main__":
+    main()

@@ -201,6 +201,62 @@ def report(goal: str = "ReplicaDistributionGoal",
     }
 
 
+def flight_overhead_report(goal: str = "ReplicaDistributionGoal",
+                           prev: tuple = DEFAULT_PREV,
+                           brokers: int = 50, racks: int = 10,
+                           topics: int = 40, mean_ppt: float = 84.0,
+                           rf: int = 3, capacity: int = 32) -> dict:
+    """Equation cost of the flight recorder, measured on the BUDGET fixpoint
+    (the recorder only exists there): body equations with flight_capacity=0
+    versus ``capacity``.  The off trace must be EXACTLY the pre-recorder
+    graph (overhead accounting starts from it), and the on-overhead gets its
+    own pinned ceiling in tests/test_step_graph_budget.py — the recorder is
+    opt-in telemetry, not license for unbounded per-step cost."""
+    import jax.numpy as jnp
+
+    from cruise_control_tpu.analyzer import candidates as cgen
+    from cruise_control_tpu.analyzer import optimizer as opt
+    from cruise_control_tpu.analyzer.balancing_constraint import BalancingConstraint
+    from cruise_control_tpu.analyzer.goals.specs import goals_by_priority
+    from cruise_control_tpu.analyzer.state import OptimizationOptions
+    from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster
+
+    spec_m = ClusterSpec(num_brokers=brokers, num_racks=racks,
+                         num_topics=topics, mean_partitions_per_topic=mean_ppt,
+                         replication_factor=rf, distribution="exponential",
+                         seed=2026)
+    model = generate_cluster(spec_m)
+    options = OptimizationOptions.none(model)
+    constraint = BalancingConstraint.default()
+    g = goals_by_priority([goal])[0]
+    prev_specs = tuple(goals_by_priority(list(prev)))
+    ns = cgen.default_num_sources(model)
+    nd = cgen.default_num_dests(model)
+
+    def trace(cap):
+        fix = partial(opt._goal_fixpoint_budget, spec=g,
+                      prev_specs=prev_specs, constraint=constraint,
+                      num_sources=ns, num_dests=nd, flight_capacity=cap)
+        jaxpr = jax.make_jaxpr(fix)(model, options, jnp.int32(capacity),
+                                    None).jaxpr
+        body = _find_while_body(jaxpr)
+        if body is None:
+            raise RuntimeError("no while_loop found in the budget jaxpr")
+        return count_equations(body), count_equations(jaxpr)
+
+    body_off, total_off = trace(0)
+    body_on, total_on = trace(capacity)
+    return {
+        "goal": goal,
+        "num_brokers": brokers,
+        "flight_capacity": capacity,
+        "body_equations_off": body_off,
+        "body_equations_on": body_on,
+        "body_overhead": body_on - body_off,
+        "outer_overhead": (total_on - body_on) - (total_off - body_off),
+    }
+
+
 def chunk_reuse_report(goal: str = "ReplicaDistributionGoal",
                        brokers: int = 50, racks: int = 10, topics: int = 40,
                        mean_ppt: float = 84.0, rf: int = 3,
@@ -280,7 +336,24 @@ def main() -> None:
     p.add_argument("--chunk-reuse", action="store_true",
                    help="check the chunk driver reuses one executable per "
                         "(goal, bucket shape) instead of the jaxpr report")
+    p.add_argument("--flight", action="store_true",
+                   help="measure the flight recorder's step-graph overhead "
+                        "(budget fixpoint, capacity on vs off)")
     args = p.parse_args()
+    if args.flight:
+        rec = flight_overhead_report(goal=args.goal, brokers=args.brokers)
+        if args.json:
+            print(json.dumps(rec), flush=True)
+        else:
+            print(f"goal: {rec['goal']}  (B={rec['num_brokers']}, "
+                  f"C={rec['flight_capacity']})")
+            print(f"  body equations (recorder off): "
+                  f"{rec['body_equations_off']}")
+            print(f"  body equations (recorder on) : "
+                  f"{rec['body_equations_on']}")
+            print(f"  body overhead                : {rec['body_overhead']}")
+            print(f"  outer overhead               : {rec['outer_overhead']}")
+        return
     if args.chunk_reuse:
         rec = chunk_reuse_report(goal=args.goal, brokers=args.brokers)
         if args.json:
