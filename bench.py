@@ -312,6 +312,91 @@ def run_rung(scale: str, max_candidates, fast: bool) -> dict:
     return rec
 
 
+def run_execute_rung(scale: str, max_candidates, fast: bool) -> dict:
+    """--execute: drive a REAL rung proposal plan through the executor
+    against the simulated fleet (SimulatedClusterAdmin — per-replica
+    transfer times from replica size + throttle, virtual clock) and record
+    the execution ledger's time-to-balanced telemetry.  Writes
+    EXEC_<rung>.json (tools/execution_report.py renders it)."""
+    brokers, racks, topics, ppt, rf = SCALES[scale]
+
+    import jax
+
+    from cruise_control_tpu.analyzer import optimizer as opt
+    from cruise_control_tpu.analyzer import proposals as props
+    from cruise_control_tpu.executor import simulate as sim
+    from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster
+
+    spec = ClusterSpec(num_brokers=brokers, num_racks=racks, num_topics=topics,
+                       mean_partitions_per_topic=ppt, replication_factor=rf,
+                       distribution="exponential", seed=2026)
+    model = jax.device_put(generate_cluster(spec))
+    jax.block_until_ready(model)
+    num_replicas = int(model.replica_valid.sum())
+
+    # One optimize pass produces the real proposal plan — this rung measures
+    # execution, not proposal wall, so no timed warm-up pass is needed.
+    run = opt.optimize(opt.donation_copy(model), STACK,
+                       raise_on_hard_failure=False, fused=True,
+                       max_candidates_per_step=max_candidates, fast_mode=fast,
+                       donate_model=True)
+    proposals = props.diff(model, run.model)
+    inter_bytes = sum(int(p.partition_size * 1e6) * len(p.replicas_to_add)
+                      for p in proposals)
+    # Throttle sized so the fleet drains in O(1k) virtual ticks (one poll
+    # per tick is host-side Python): aggregate drain rate is roughly
+    # rate × busy destination brokers.
+    rate = max(1_000_000.0, inter_bytes / max(brokers, 1) / 300.0)
+
+    t0 = time.monotonic()
+    result, ex, admin = sim.run_simulated_execution(
+        model, proposals, model_after=run.model,
+        goal_names=[g.name for g in run.goal_results],
+        tick_ms=1000, rate_bytes_per_sec=rate)
+    host_wall_s = time.monotonic() - t0
+    prog = ex.progress(verbose=True)
+
+    fleet_s = prog["elapsedMs"] / 1000.0
+    curve = [{k: v for k, v in cp.items()} for cp in prog["checkpoints"]]
+    scored = [c["balancedness"] for c in curve
+              if c.get("balancedness") is not None]
+    rec = {
+        "metric": f"execution_wall_to_balanced_{scale}",
+        "value": round(fleet_s, 3),
+        "unit": "s",
+        # No recorded execution baseline yet — this artifact IS the yardstick
+        # future executor perf work is judged against.
+        "vs_baseline": 1.0,
+        "host_wall_s": round(host_wall_s, 3),
+        "proposals_per_sec": round(len(proposals) / max(fleet_s, 1e-9), 3),
+        "num_brokers": brokers,
+        "num_replicas": num_replicas,
+        "num_proposals": len(proposals),
+        "plan": {"totalTasks": prog["totalTasks"],
+                 "totalBytes": prog["totalBytes"]},
+        "result": {"completed": result.completed, "dead": result.dead,
+                   "aborted": result.aborted, "polls": result.polls,
+                   "stopped": result.stopped},
+        "wall_to_balanced_s": round(fleet_s, 3),
+        "balancedness_before": round(run.balancedness_before, 3),
+        "balancedness_after": round(run.balancedness_after, 3),
+        "balancedness_final": scored[-1] if scored else None,
+        "throttle": {"rateBytesPerSec": rate, "tickMs": 1000},
+        "adjuster_decisions": prog["adjusterDecisions"],
+        "phases": prog["phases"],
+        "task_durations_ms": prog["taskDurations"],
+        "curve": curve,
+        **({"fast_mode": True} if fast else {}),
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        f"EXEC_{scale}.json")
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1, sort_keys=True)
+        f.write("\n")
+    rec["exec_artifact"] = os.path.basename(path)
+    return rec
+
+
 def main() -> None:
     # Rung selection: --rungs flag > BENCH_SCALE env > default small,mid.
     # The default deliberately stops at mid (~10k replicas): it is the
@@ -334,10 +419,16 @@ def main() -> None:
                     help="record per-step flight telemetry "
                          "(CRUISE_FLIGHT_RECORDER=1) and write a "
                          "FLIGHT_<rung>.json artifact per rung")
+    ap.add_argument("--execute", action="store_true",
+                    help="run the execution-ledger rung(s) instead: optimize "
+                         "a real proposal plan, execute it against the "
+                         "simulated fleet, write EXEC_<rung>.json "
+                         "(default rung: mid)")
     args = ap.parse_args()
     if args.flight:
         os.environ["CRUISE_FLIGHT_RECORDER"] = "1"
-    scale_sel = args.rungs or os.environ.get("BENCH_SCALE") or "small,mid"
+    default_rungs = "mid" if args.execute else "small,mid"
+    scale_sel = args.rungs or os.environ.get("BENCH_SCALE") or default_rungs
     scales = (["small", "mid", "large"] if scale_sel == "ladder"
               else [s.strip() for s in scale_sel.split(",") if s.strip()])
     if not scales or any(s not in SCALES for s in scales):
@@ -368,12 +459,15 @@ def main() -> None:
 
     if os.environ.get("BENCH_SELFTEST_WEDGE") == "1":
         # Regression hook for the kill-signal path: record one synthetic
-        # rung, then wedge like a hung backend until the harness' TERM (or
-        # the total-budget watchdog) arrives.  Exercised by the suite; never
-        # set in real runs.
-        _record_rung({"metric": "wall_clock_to_goal_satisfying_proposal_small",
-                      "value": 0.0, "unit": "s", "vs_baseline": 0.0,
-                      "selftest": True})
+        # rung (execute-flavored under --execute so the execute path's final
+        # line is covered too), then wedge like a hung backend until the
+        # harness' TERM (or the total-budget watchdog) arrives.  Exercised
+        # by the suite; never set in real runs.
+        metric = ("execution_wall_to_balanced_small" if args.execute
+                  else "wall_clock_to_goal_satisfying_proposal_small")
+        _record_rung({"metric": metric, "value": 0.0, "unit": "s",
+                      "vs_baseline": 0.0, "selftest": True,
+                      **({"execute": True} if args.execute else {})})
         while True:
             signal.pause()
 
@@ -390,7 +484,8 @@ def main() -> None:
     # Phase 2: the rungs, each under its own deadline.
     for s in scales:
         cancel = _watchdog(rung_timeout, f"rung_timeout_{s}")
-        rec = run_rung(s, max_candidates, fast)
+        rec = (run_execute_rung(s, max_candidates, fast) if args.execute
+               else run_rung(s, max_candidates, fast))
         cancel()
         rec["backend"] = platform
         rec["backend_init_s"] = round(init_s, 1)

@@ -1872,6 +1872,113 @@ def _get_sweep_fn(specs: Tuple[GoalSpec, ...],
     return fn
 
 
+# Execution-time balancedness re-scoring: as movement batches land, the
+# executor's ledger wants "how far from the optimized placement are we?" in
+# the same units the optimizer reports (balancedness_before/after).  One
+# compile-cached program evaluates the full goal-stack sweep over a BATCH of
+# landed-partition masks — lax.map keeps the program a single sweep body, so
+# a batch of checkpoints costs one dispatch, never one per poll.
+_placement_score_cache: Dict[tuple, object] = {}
+
+
+def _get_placement_score_fn(specs: Tuple[GoalSpec, ...],
+                            constraint: BalancingConstraint, batch: int):
+    key = (specs, constraint, batch)
+    fn = _placement_score_cache.get(key)
+    if fn is None:
+        def run(before, after, masks):
+            def one(mask):
+                rmask = mask[before.replica_partition]
+                blended = before.with_placement(
+                    jnp.where(rmask, after.replica_broker,
+                              before.replica_broker),
+                    jnp.where(rmask, after.replica_is_leader,
+                              before.replica_is_leader),
+                    jnp.where(rmask, after.replica_disk,
+                              before.replica_disk))
+                sat, _ = _stack_satisfied(blended, specs=specs,
+                                          constraint=constraint)
+                return sat
+            return jax.lax.map(one, masks)
+        fn = jax.jit(run)
+        _placement_score_cache[key] = fn
+    return fn
+
+
+class PlacementScorer:
+    """Balancedness of execution checkpoints, batched and compile-cached.
+
+    A checkpoint is a set of *landed* partitions (all tasks completed); the
+    hypothetical cluster at that instant places landed partitions at the
+    optimized (after) placement and the rest at the pre-execution (before)
+    placement.  ``score`` runs the goal-stack satisfaction sweep over the
+    whole batch of checkpoints in one jitted dispatch (batch padded to a
+    power of two so the executable is reused across flushes) and converts
+    violations to the optimizer's balancedness scale: 100 minus each
+    violated goal's priority/strictness cost.
+    """
+
+    def __init__(self, model_before: TensorClusterModel,
+                 model_after: TensorClusterModel,
+                 goal_names: Sequence[str],
+                 constraint: Optional[BalancingConstraint] = None,
+                 priority_weight: float = 1.1,
+                 strictness_weight: float = 1.5):
+        from cruise_control_tpu.analyzer.balancedness import \
+            balancedness_cost_by_goal
+        # goals_by_priority returns a list; tuple() so cache keys hash.
+        self._specs = tuple(goals_by_priority(list(goal_names)))
+        self._constraint = constraint or BalancingConstraint.default()
+        self._before = model_before
+        self._after = model_after
+        costs = balancedness_cost_by_goal(self._specs, priority_weight,
+                                          strictness_weight)
+        self._costs = np.array([costs[s.name] for s in self._specs],
+                               np.float64)
+        self.dispatches = 0
+
+    @classmethod
+    def for_run(cls, model_before: TensorClusterModel, run: "OptimizerRun",
+                constraint: Optional[BalancingConstraint] = None,
+                priority_weight: float = 1.1,
+                strictness_weight: float = 1.5) -> "PlacementScorer":
+        """Scorer from an optimization result: before = the model the run
+        started from, after = the optimized placement, goals = the run's
+        stack — the facade builds this for non-dryrun executions."""
+        return cls(model_before, run.model,
+                   [g.name for g in run.goal_results], constraint,
+                   priority_weight, strictness_weight)
+
+    @property
+    def num_partitions(self) -> int:
+        return int(self._before.partition_valid.shape[0])
+
+    def score_landed(self, landed_sets: Sequence) -> np.ndarray:
+        """Scores for a batch of landed-partition id sets (the ledger's
+        checkpoint representation)."""
+        masks = np.zeros((len(landed_sets), self.num_partitions), bool)
+        for i, landed in enumerate(landed_sets):
+            if landed:
+                masks[i, np.fromiter(landed, int, len(landed))] = True
+        return self.score(masks)
+
+    def score(self, masks: np.ndarray) -> np.ndarray:
+        """f64[C] balancedness for bool[C, P] landed masks (one dispatch)."""
+        masks = np.asarray(masks, bool)
+        c = masks.shape[0]
+        if c == 0:
+            return np.zeros((0,), np.float64)
+        c_pad = 1 << (c - 1).bit_length()
+        padded = np.zeros((c_pad, masks.shape[1]), bool)
+        padded[:c] = masks
+        fn = _get_placement_score_fn(self._specs, self._constraint, c_pad)
+        sat = np.asarray(jax.device_get(
+            fn(self._before, self._after, jnp.asarray(padded))))
+        self.dispatches += 1
+        violated = ~sat[:c]
+        return 100.0 - violated.astype(np.float64) @ self._costs
+
+
 def _stack_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
                     specs: Tuple[GoalSpec, ...], constraint: BalancingConstraint,
                     num_sources: int, num_dests: int, max_steps: int, mesh=None,

@@ -317,13 +317,19 @@ class CruiseControl:
         execution = None
         ok = True
         if not dryrun and proposals:
+            # The scorer re-scores balancedness over the ledger's landed-set
+            # checkpoints (dense partition ids survive renumber_brokers, so
+            # ledger masks address the model directly).
+            scorer = opt.PlacementScorer.for_run(
+                model, run, self.constraint, *self._balancedness_weights)
             # Live broker health feeds the ConcurrencyAdjuster during the
             # wait loop (Executor.java:335-447 reads request-queue depth /
             # handler idle ratio each interval).
             execution = self.executor.execute_proposals(
                 proposals, naming["partitions"],
                 concurrency_adjust_metrics=self.load_monitor.broker_health_metrics,
-                strategy=strategy, replication_throttle=replication_throttle)
+                strategy=strategy, replication_throttle=replication_throttle,
+                balancedness_scorer=scorer)
             ok = execution.ok
         return OperationResult(
             ok=ok, dryrun=dryrun, proposals=proposals,

@@ -47,7 +47,7 @@ PREFIX = "/kafkacruisecontrol"
 
 GET_ENDPOINTS = {"bootstrap", "train", "load", "partition_load", "proposals",
                  "state", "kafka_cluster_state", "user_tasks", "review_board",
-                 "metrics", "trace", "flight"}
+                 "metrics", "trace", "flight", "executor_state"}
 POST_ENDPOINTS = {"add_broker", "remove_broker", "fix_offline_replicas",
                   "rebalance", "stop_proposal_execution", "pause_sampling",
                   "resume_sampling", "demote_broker", "admin", "review",
@@ -316,6 +316,15 @@ class CruiseControlApi:
 
     def _ep_kafka_cluster_state(self, q):
         return 200, self.cc.kafka_cluster_state(), {}
+
+    def _ep_executor_state(self, q):
+        """Execution-ledger progress: live per-broker in-flight, bytes
+        moved/total, ETA, adjuster decisions, per-phase records and the
+        balancedness-over-time checkpoints.  ``?verbose=true`` adds the
+        per-broker map, checkpoint curve and recent lifecycle events (the
+        reference's ExecutorState verbose JSON, ExecutorState.java:332)."""
+        verbose = _parse_bool(q, "verbose", False)
+        return 200, self.cc.executor.progress(verbose=verbose), {}
 
     def _ep_metrics(self, q):
         """Sensor registry (Sensors.md): JSON by default; Prometheus
@@ -633,6 +642,7 @@ _INDEX_HTML = """<!doctype html>
  <a href="%PREFIX%/kafka_cluster_state">kafka_cluster_state</a>
  <a href="%PREFIX%/proposals">proposals</a>
  <a href="%PREFIX%/metrics">metrics</a>
+ <a href="%PREFIX%/executor_state?verbose=true">executor_state</a>
  <a href="%PREFIX%/trace">trace</a>
  <a href="%PREFIX%/user_tasks">user_tasks</a>
 </div>

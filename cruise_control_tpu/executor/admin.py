@@ -195,3 +195,101 @@ class InMemoryClusterAdmin(ClusterAdmin):
             state["brokers"] = set(state["brokers"]) - set(brokers)
             if not state["brokers"]:
                 self.throttle_state = {}
+
+
+class SimulatedClusterAdmin(InMemoryClusterAdmin):
+    """Byte-accurate fleet simulation under a virtual clock.
+
+    ``InMemoryClusterAdmin`` completes every reassignment after a fixed
+    number of polls — fine for exercising wait loops, useless for measuring
+    time-to-balanced.  This subclass models the data plane: each
+    reassignment must drain ``replica size × new destinations`` bytes at the
+    replication-throttle rate, and a broker's rate is SHARED across its
+    concurrent inbound transfers (the bottleneck broker paces each
+    transfer), so concurrency limits and the adjuster visibly change the
+    wall-to-balanced outcome.  The virtual clock advances ``tick_ms`` per
+    ``ongoing_reassignments()`` poll; executors built with
+    ``clock_ms=admin.now_ms`` record ledger time in fleet seconds.  Scales
+    to the ROADMAP's 7k-broker fleet: state is one dict entry per in-flight
+    transfer, not per broker.
+    """
+
+    def __init__(self, metadata_client: MetadataClient,
+                 bytes_by_tp: Optional[Dict[Tp, int]] = None,
+                 tick_ms: int = 1000,
+                 rate_bytes_per_sec: float = 50_000_000.0):
+        super().__init__(metadata_client, latency_polls=0)
+        self._bytes_by_tp: Dict[Tp, int] = dict(bytes_by_tp or {})
+        self._tick_ms = max(1, int(tick_ms))
+        self._rate = float(rate_bytes_per_sec)
+        self._now_ms = 0
+        # tp → [remaining_bytes, destination brokers receiving data]
+        self._transfers: Dict[Tp, list] = {}
+
+    def now_ms(self) -> int:
+        return self._now_ms
+
+    @property
+    def rate_bytes_per_sec(self) -> float:
+        return self._rate
+
+    # -- reassignment ------------------------------------------------------
+    def alter_partition_reassignments(self, requests: Sequence[ReassignmentRequest]) -> None:
+        with self._lock:
+            cluster = self._md.cluster()
+            current = {p.tp: set(p.replicas) for p in cluster.partitions}
+            for r in requests:
+                tp = tuple(r.tp)
+                if tp in self._inflight:
+                    raise RuntimeError(f"reassignment already in progress for {r.tp}")
+                if tp not in current:
+                    raise ValueError(f"unknown partition {r.tp}")
+                dests = frozenset(b for b in r.new_replicas
+                                  if b not in current[tp])
+                size = self._bytes_by_tp.get(tp, 0) * len(dests)
+                self._inflight[tp] = (r, 0)
+                self._transfers[tp] = [float(size), dests]
+
+    def ongoing_reassignments(self) -> Set[Tp]:
+        with self._lock:
+            self._now_ms += self._tick_ms
+            # Per-destination-broker inbound transfer counts: a broker
+            # receiving N partitions splits its throttle rate N ways.
+            inbound: Dict[int, int] = {}
+            for _remaining, dests in self._transfers.values():
+                for b in dests:
+                    inbound[b] = inbound.get(b, 0) + 1
+            tick_s = self._tick_ms / 1000.0
+            done: List[Tp] = []
+            for tp, entry in self._transfers.items():
+                remaining, dests = entry
+                if dests:
+                    bottleneck = max(inbound[b] for b in dests)
+                    remaining -= self._rate / bottleneck * tick_s
+                    entry[0] = remaining
+                if not dests or remaining <= 0:
+                    done.append(tp)
+            for tp in done:
+                req, _ = self._inflight.pop(tp)
+                del self._transfers[tp]
+                self._apply(req)
+            return set(self._inflight)
+
+    def cancel_reassignments(self, tps: Optional[Sequence[Tp]] = None) -> None:
+        with self._lock:
+            if tps is None:
+                self._inflight.clear()
+                self._transfers.clear()
+            else:
+                for tp in tps:
+                    self._inflight.pop(tuple(tp), None)
+                    self._transfers.pop(tuple(tp), None)
+
+    # -- throttles ---------------------------------------------------------
+    def set_replication_throttles(self, rate_bytes_per_sec, brokers,
+                                  throttled_replicas) -> None:
+        super().set_replication_throttles(rate_bytes_per_sec, brokers,
+                                          throttled_replicas)
+        # Adopt the executor's throttle as the simulation's transfer rate so
+        # per-replica transfer times derive from size + throttle.
+        self._rate = float(rate_bytes_per_sec)

@@ -30,6 +30,7 @@ from cruise_control_tpu.analyzer.proposals import ExecutionProposal
 from cruise_control_tpu.common.sensors import SENSORS
 from cruise_control_tpu.common.tracing import TRACE
 from cruise_control_tpu.executor.admin import ClusterAdmin, ReassignmentRequest, Tp
+from cruise_control_tpu.executor.ledger import ExecutionLedger
 from cruise_control_tpu.executor.planner import ExecutionPlan, ExecutionTaskPlanner
 from cruise_control_tpu.executor.strategy import ReplicaMovementStrategy, StrategyContext
 from cruise_control_tpu.executor.task import ExecutionTask, TaskState, TaskType
@@ -128,7 +129,9 @@ class Executor:
                  concurrency_adjuster_enabled: bool = True,
                  concurrency_adjuster_interval_ms: int = 0,
                  concurrency_adjuster_min_per_broker: int = 1,
-                 concurrency_adjuster_max_per_broker: Optional[int] = None):
+                 concurrency_adjuster_max_per_broker: Optional[int] = None,
+                 ledger_enabled: bool = True,
+                 clock_ms: Optional[Callable[[], int]] = None):
         self._admin = admin
         self._metadata = metadata_client
         self._limits = limits or ConcurrencyLimits()
@@ -161,6 +164,12 @@ class Executor:
                                concurrency_adjuster_interval_ms)
         self._task_manager: Optional[ExecutionTaskManager] = None
         self._adjuster = ConcurrencyAdjuster(self._limits, *self._adjuster_args)
+        # Execution ledger (per-task lifecycle log + progress accounting).
+        # The clock is pluggable so simulated executions record fleet time;
+        # the ledger of the latest execution persists for post-run queries.
+        self._ledger_enabled = ledger_enabled
+        self._clock_ms = clock_ms or (lambda: int(time.time() * 1000))
+        self._ledger: Optional[ExecutionLedger] = None
         # Sensor registrations (Executor.registerGaugeSensors,
         # Executor.java:271; Sensors.md execution gauges).
         from cruise_control_tpu.executor.task import TaskType as _TT
@@ -200,6 +209,54 @@ class Executor:
             "Executor.tasks-dead",
             help="Execution tasks abandoned in DEAD state")
 
+        # Ledger-driven progress gauges.  All read the latest execution's
+        # ledger (live or finished); sentinel values cover the no-ledger
+        # case so the families register deterministically at boot.
+        def _ledger_read(fn, default=0.0):
+            def read() -> float:
+                led = self._ledger
+                return default if led is None else float(fn(led))
+            return read
+
+        SENSORS.gauge("Executor.bytes-moved",
+                      _ledger_read(lambda led: led.bytes_moved),
+                      help="Bytes moved so far by the latest execution")
+        SENSORS.gauge("Executor.bytes-total",
+                      _ledger_read(lambda led: led.total_bytes),
+                      help="Total bytes the latest execution plan moves")
+        SENSORS.gauge("Executor.bytes-in-flight",
+                      _ledger_read(lambda led: led.bytes_in_flight),
+                      help="Bytes of movement currently in flight")
+        SENSORS.gauge("Executor.movement-rate-bytes-per-sec",
+                      _ledger_read(
+                          lambda led: led.movement_rate_bytes_per_sec),
+                      help="Observed data movement rate of the latest "
+                           "execution")
+        SENSORS.gauge("Executor.eta-seconds",
+                      _ledger_read(lambda led: led.eta_seconds, -1.0),
+                      help="Remaining bytes over the observed movement rate "
+                           "(-1 while unknown)")
+        SENSORS.gauge("Executor.throttle-utilization",
+                      _ledger_read(lambda led: led.throttle_utilization, -1.0),
+                      help="Observed movement rate over the replication-"
+                           "throttle ceiling (-1 when unthrottled or idle)")
+        SENSORS.gauge("Executor.max-broker-in-flight",
+                      _ledger_read(lambda led: led.max_broker_in_flight),
+                      help="Largest per-broker in-flight movement count")
+        SENSORS.gauge("Executor.balancedness-score",
+                      _ledger_read(lambda led: led.balancedness, -1.0),
+                      help="Balancedness at the latest scored execution "
+                           "checkpoint (-1 until one is scored)")
+        self._sensor_adjuster = {
+            d: SENSORS.counter(
+                "Executor.adjuster-decisions", labels={"decision": d},
+                help="Concurrency-adjuster decisions by outcome")
+            for d in ("halve", "double", "hold")}
+        for tt in _TT:
+            SENSORS.histogram(
+                "Executor.task-duration-seconds", labels={"type": tt.value},
+                help="Completed execution task duration, by task type")
+
     # -- state -------------------------------------------------------------
     def state(self) -> ExecutorState:
         with self._lock:
@@ -234,6 +291,19 @@ class Executor:
             out["recentlyRemovedBrokers"] = sorted(self.recently_removed_brokers())
             out["recentlyDemotedBrokers"] = sorted(self.recently_demoted_brokers())
             return out
+
+    def progress(self, verbose: bool = False) -> Dict[str, object]:
+        """Execution-ledger progress of the latest (or live) execution —
+        the ``GET /executor_state`` payload (the reference's executor
+        substate, ExecutorState.java:331-389, plus the ledger's bytes/ETA/
+        curve accounting)."""
+        with self._lock:
+            out: Dict[str, object] = {"state": self._state.value,
+                                      "ledgerEnabled": self._ledger_enabled}
+            led = self._ledger
+        if led is not None:
+            out.update(led.to_dict(verbose=verbose))
+        return out
 
     # -- reservation handshake (Executor.java:828) --------------------------
     def set_generating_proposals_for_execution(self) -> None:
@@ -301,13 +371,17 @@ class Executor:
             return set(self._recently_demoted)
 
     @contextmanager
-    def _phase_probe(self, phase: str, tasks: int):
-        """Span + duration histogram around one execution phase."""
+    def _phase_probe(self, phase: str, tasks: int,
+                     ledger: Optional[ExecutionLedger] = None):
+        """Span + duration histogram around one execution phase.  Yields the
+        span so the phase runner can annotate polls/batches/bytes onto it."""
         hist = SENSORS.histogram(
             "Executor.phase-duration-seconds", labels={"phase": phase},
             help="Wall time spent in each execution phase")
-        with TRACE.span(f"executor.{phase}", tasks=tasks), hist.time():
-            yield
+        if ledger is not None:
+            ledger.phase_started(phase)
+        with TRACE.span(f"executor.{phase}", tasks=tasks) as sp, hist.time():
+            yield sp
 
     # -- main entry ----------------------------------------------------------
     def execute_proposals(self, proposals: Sequence[ExecutionProposal],
@@ -318,7 +392,8 @@ class Executor:
                           concurrency_adjust_metrics: Optional[
                               Callable[[], Dict[int, Dict[str, float]]]] = None,
                           strategy: Optional[ReplicaMovementStrategy] = None,
-                          replication_throttle: Optional[int] = None
+                          replication_throttle: Optional[int] = None,
+                          balancedness_scorer=None
                           ) -> ExecutionResult:
         """Run the full three-phase execution to completion.
 
@@ -330,6 +405,9 @@ class Executor:
         movement strategy and throttle rate for THIS execution only (the
         reference accepts both per request,
         ParameterUtils.java:418 + :733; KafkaCruiseControl.java:465-495).
+        ``balancedness_scorer`` (a ``PlacementScorer``) attaches goal-distance
+        re-scoring to the ledger's checkpoints — batched at phase boundaries,
+        never per poll.
         """
         if poll_interval_s is None:
             poll_interval_s = self._progress_check_interval_s
@@ -355,8 +433,17 @@ class Executor:
                         if replication_throttle is not None else self._throttle)
             plan = planner.plan(proposals, context)
             tm = ExecutionTaskManager(plan, self._limits)
+            ledger: Optional[ExecutionLedger] = None
+            if self._ledger_enabled:
+                rate = (replication_throttle if replication_throttle is not None
+                        else self._throttle.rate_bytes_per_sec)
+                ledger = ExecutionLedger(self._clock_ms,
+                                         throttle_rate_bytes_per_sec=rate,
+                                         scorer=balancedness_scorer)
+                ledger.attach(plan)
             with self._lock:
                 self._task_manager = tm
+                self._ledger = ledger
             polls = 0
             stopped = False
 
@@ -373,30 +460,36 @@ class Executor:
                     throttle.set_throttles(plan.inter_broker_tasks, partition_names)
                     try:
                         with self._phase_probe("inter_broker",
-                                               len(plan.inter_broker_tasks)):
+                                               len(plan.inter_broker_tasks),
+                                               ledger) as psp:
                             polls, stopped = self._run_inter_broker_phase(
                                 tm, partition_names, max_polls, poll_interval_s,
-                                concurrency_adjust_metrics)
+                                concurrency_adjust_metrics, ledger, psp)
                     finally:
                         throttle.clear_throttles(plan.inter_broker_tasks,
                                                  partition_names)
+                    if ledger is not None:
+                        ledger.score_checkpoints()
 
                 # Phase 2: intra-broker (logdir) movement.
                 if plan.intra_broker_tasks and not stopped and not self._stop_requested:
                     with self._lock:
                         self._state = ExecutorState.INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
                     with self._phase_probe("intra_broker",
-                                           len(plan.intra_broker_tasks)):
-                        self._run_intra_broker_phase(tm, partition_names)
+                                           len(plan.intra_broker_tasks),
+                                           ledger) as psp:
+                        self._run_intra_broker_phase(tm, partition_names,
+                                                     ledger, psp)
 
                 # Phase 3: leadership movement (batched preferred elections).
                 if plan.leadership_tasks and not stopped and not self._stop_requested:
                     with self._lock:
                         self._state = ExecutorState.LEADER_MOVEMENT_TASK_IN_PROGRESS
                     with self._phase_probe("leadership",
-                                           len(plan.leadership_tasks)):
+                                           len(plan.leadership_tasks),
+                                           ledger) as psp:
                         self._run_leadership_phase(tm, partition_names, max_polls,
-                                                   poll_interval_s)
+                                                   poll_interval_s, ledger, psp)
 
                 stopped = stopped or self._stop_requested
                 buckets = tm.tasks_by_state()
@@ -404,6 +497,12 @@ class Executor:
                     self._sensor_stopped.inc()
                 self._sensor_completed.inc(len(buckets[TaskState.COMPLETED]))
                 self._sensor_dead.inc(len(buckets[TaskState.DEAD]))
+                if ledger is not None:
+                    ledger.finished()
+                    ledger.poll(tm, force=True)
+                    ledger.score_checkpoints()
+                    sp.annotate(bytes_total=ledger.total_bytes,
+                                bytes_moved=ledger.bytes_moved)
                 sp.annotate(completed=len(buckets[TaskState.COMPLETED]),
                             dead=len(buckets[TaskState.DEAD]),
                             stopped=stopped, polls=polls)
@@ -422,103 +521,150 @@ class Executor:
     def _target_replicas(self, task: ExecutionTask) -> Tuple[int, ...]:
         return tuple(r.broker for r in task.proposal.new_replicas)
 
+    def _adjust_concurrency(self, tm: ExecutionTaskManager, metrics_fn,
+                            ledger: Optional[ExecutionLedger]) -> None:
+        """One adjuster evaluation; classifies the decision (halve / double /
+        hold) by comparing the per-broker limit before and after, since the
+        adjuster itself is interval-gated and may return the input."""
+        before = tm.limits.inter_broker_per_broker
+        tm.set_limits(self._adjuster.adjust(
+            tm.limits, metrics_fn(),
+            has_min_isr_pressure=self._min_isr_pressure_fn()))
+        after = tm.limits.inter_broker_per_broker
+        decision = ("halve" if after < before
+                    else "double" if after > before else "hold")
+        self._sensor_adjuster[decision].inc()
+        if ledger is not None:
+            ledger.adjuster_decision(decision)
+
     def _run_inter_broker_phase(self, tm: ExecutionTaskManager,
                                 partition_names: Sequence[Tp], max_polls: int,
-                                poll_interval_s: float,
-                                metrics_fn) -> Tuple[int, bool]:
+                                poll_interval_s: float, metrics_fn,
+                                ledger: Optional[ExecutionLedger] = None,
+                                span=None) -> Tuple[int, bool]:
         submitted: Dict[int, ExecutionTask] = {}
         polls = 0
-        while polls < max_polls:
-            if self._stop_requested:
-                # Graceful stop: let in-flight tasks finish, admit no more;
-                # force-stop also cancels in-flight (handled via admin above).
-                for t in list(submitted.values()):
-                    if self._force_stop and t.state == TaskState.IN_PROGRESS:
-                        t.aborting()
-                        t.aborted()
-                        tm.finished(t)
-                        del submitted[t.execution_id]
-                if self._force_stop:
-                    return polls, True
-            else:
-                new_tasks = tm.next_inter_broker_tasks()
-                if new_tasks:
-                    reqs = []
-                    for t in new_tasks:
-                        t.in_progress()
-                        submitted[t.execution_id] = t
-                        reqs.append(ReassignmentRequest(
-                            tp=partition_names[t.proposal.partition],
-                            new_replicas=self._target_replicas(t)))
-                    self._admin.alter_partition_reassignments(reqs)
+        batches = 0
+        try:
+            while polls < max_polls:
+                if self._stop_requested:
+                    # Graceful stop: let in-flight tasks finish, admit no more;
+                    # force-stop also cancels in-flight (handled via admin above).
+                    for t in list(submitted.values()):
+                        if self._force_stop and t.state == TaskState.IN_PROGRESS:
+                            now = self._clock_ms()
+                            t.aborting(now)
+                            t.aborted(now)
+                            tm.finished(t)
+                            del submitted[t.execution_id]
+                    if self._force_stop:
+                        return polls, True
+                else:
+                    new_tasks = tm.next_inter_broker_tasks()
+                    if new_tasks:
+                        batches += 1
+                        reqs = []
+                        now = self._clock_ms()
+                        for t in new_tasks:
+                            t.in_progress(now)
+                            submitted[t.execution_id] = t
+                            reqs.append(ReassignmentRequest(
+                                tp=partition_names[t.proposal.partition],
+                                new_replicas=self._target_replicas(t)))
+                        self._admin.alter_partition_reassignments(reqs)
 
-            ongoing = self._admin.ongoing_reassignments()
-            cluster = self._metadata.cluster()
-            by_tp = {p.tp: p for p in cluster.partitions}
-            alive = set(cluster.alive_broker_ids())
-            for t in list(submitted.values()):
-                tp = tuple(partition_names[t.proposal.partition])
-                target = set(self._target_replicas(t))
-                part = by_tp.get(tp)
-                if tp not in ongoing and part is not None and \
-                        set(part.replicas) == target:
-                    t.completed()
-                    tm.finished(t)
-                    del submitted[t.execution_id]
-                elif not target <= alive:
-                    # Destination broker died mid-move (Executor.java:1548).
-                    if t.state == TaskState.IN_PROGRESS:
-                        t.kill()
+                ongoing = self._admin.ongoing_reassignments()
+                cluster = self._metadata.cluster()
+                by_tp = {p.tp: p for p in cluster.partitions}
+                alive = set(cluster.alive_broker_ids())
+                for t in list(submitted.values()):
+                    tp = tuple(partition_names[t.proposal.partition])
+                    target = set(self._target_replicas(t))
+                    part = by_tp.get(tp)
+                    if tp not in ongoing and part is not None and \
+                            set(part.replicas) == target:
+                        t.completed(self._clock_ms())
                         tm.finished(t)
-                        self._admin.cancel_reassignments([tp])
                         del submitted[t.execution_id]
-            polls += 1
-            if metrics_fn is not None and self._adjuster_enabled:
-                tm.set_limits(self._adjuster.adjust(
-                    tm.limits, metrics_fn(),
-                    has_min_isr_pressure=self._min_isr_pressure_fn()))
-            if not submitted:
-                pending = [t for t in tm._plan.inter_broker_tasks
-                           if t.state == TaskState.PENDING]
-                if not pending or self._stop_requested:
-                    return polls, False
-            if poll_interval_s:
-                time.sleep(poll_interval_s)
-        return polls, True
+                    elif not target <= alive:
+                        # Destination broker died mid-move (Executor.java:1548).
+                        if t.state == TaskState.IN_PROGRESS:
+                            t.kill(self._clock_ms())
+                            tm.finished(t)
+                            self._admin.cancel_reassignments([tp])
+                            del submitted[t.execution_id]
+                polls += 1
+                if ledger is not None:
+                    ledger.poll(tm)
+                if metrics_fn is not None and self._adjuster_enabled:
+                    self._adjust_concurrency(tm, metrics_fn, ledger)
+                if not submitted:
+                    pending = [t for t in tm._plan.inter_broker_tasks
+                               if t.state == TaskState.PENDING]
+                    if not pending or self._stop_requested:
+                        return polls, False
+                if poll_interval_s:
+                    time.sleep(poll_interval_s)
+            return polls, True
+        finally:
+            if ledger is not None:
+                ledger.phase_finished(polls=polls, batches=batches)
+            if span is not None:
+                span.annotate(polls=polls, batches=batches)
+                if ledger is not None:
+                    span.annotate(bytes_moved=ledger.bytes_moved)
 
     def _run_intra_broker_phase(self, tm: ExecutionTaskManager,
-                                partition_names: Sequence[Tp]) -> None:
+                                partition_names: Sequence[Tp],
+                                ledger: Optional[ExecutionLedger] = None,
+                                span=None) -> None:
+        batches = 0
         while True:
             tasks = tm.next_intra_broker_tasks()
             if not tasks:
                 break
+            batches += 1
             moves = []
+            now = self._clock_ms()
             for t in tasks:
-                t.in_progress()
+                t.in_progress(now)
                 for broker, _old_disk, new_disk in t.proposal._intra_broker_moves():
                     logdir = self._logdir_by_disk.get(new_disk, f"/logdir-{new_disk}")
                     moves.append((partition_names[t.proposal.partition], broker, logdir))
             self._admin.alter_replica_logdirs(moves)
+            now = self._clock_ms()
             for t in tasks:
-                t.completed()
+                t.completed(now)
                 tm.finished(t)
+            if ledger is not None:
+                ledger.poll(tm)
+        if ledger is not None:
+            ledger.phase_finished(batches=batches)
+        if span is not None:
+            span.annotate(batches=batches)
 
     def _run_leadership_phase(self, tm: ExecutionTaskManager,
                               partition_names: Sequence[Tp],
                               max_polls: int = 10_000,
-                              poll_interval_s: float = 0.0) -> None:
+                              poll_interval_s: float = 0.0,
+                              ledger: Optional[ExecutionLedger] = None,
+                              span=None) -> None:
+        batches = 0
+        total_polls = 0
         while not self._stop_requested:
             tasks = tm.next_leadership_tasks()
             if not tasks:
                 break
+            batches += 1
             # Make the proposal's leader the preferred replica then trigger a
             # batched preferred-leader election (moveLeaderships,
             # Executor.java:1373-1399).
             reqs = [ReassignmentRequest(tp=partition_names[t.proposal.partition],
                                         new_replicas=self._target_replicas(t))
                     for t in tasks]
+            now = self._clock_ms()
             for t in tasks:
-                t.in_progress()
+                t.in_progress(now)
             self._admin.alter_partition_reassignments(reqs)
             polls = 0
             deadline = time.monotonic() + self._leader_movement_timeout_ms / 1000.0
@@ -527,6 +673,7 @@ class Executor:
                 polls += 1
                 if poll_interval_s:
                     time.sleep(poll_interval_s)
+            total_polls += polls
             timed_out = (polls >= max_polls or self._force_stop
                          or (self._admin.ongoing_reassignments()
                              and time.monotonic() >= deadline))
@@ -539,11 +686,18 @@ class Executor:
                 # path; the reference deletes the reassignment znodes).
                 self._admin.cancel_reassignments(
                     [partition_names[t.proposal.partition] for t in tasks])
+            now = self._clock_ms()
             for t in tasks:
                 if timed_out:
-                    t.kill()
+                    t.kill(now)
                 else:
-                    t.completed()
+                    t.completed(now)
                 tm.finished(t)
+            if ledger is not None:
+                ledger.poll(tm)
             if timed_out:
                 break
+        if ledger is not None:
+            ledger.phase_finished(polls=total_polls, batches=batches)
+        if span is not None:
+            span.annotate(polls=total_polls, batches=batches)

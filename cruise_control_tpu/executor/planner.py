@@ -34,6 +34,12 @@ class ExecutionPlan:
         return (len(self.inter_broker_tasks) + len(self.intra_broker_tasks)
                 + len(self.leadership_tasks))
 
+    @property
+    def total_bytes(self) -> int:
+        """Total data volume the plan will move (leadership moves none)."""
+        return sum(t.bytes_to_move for t in
+                   self.inter_broker_tasks + self.intra_broker_tasks)
+
 
 class ExecutionTaskPlanner:
     def __init__(self, strategy: Optional[ReplicaMovementStrategy] = None):

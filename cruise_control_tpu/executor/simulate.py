@@ -1,0 +1,185 @@
+"""Simulated-fleet execution harness.
+
+Bridges the tensor world to the executor's cluster protocol so a REAL
+proposal plan (e.g. a mid-rung optimization's diff) can be executed against
+``SimulatedClusterAdmin``'s byte-accurate virtual fleet — the measurement
+rig behind ``bench.py --execute``, ``dump_sensors``'s executor exercise,
+and the ledger tests.  Everything here is host-side glue: one device fetch
+pulls the placement arrays, after which metadata synthesis is pure Python.
+
+The seam invariants (matching ``api.facade``): brokers in the synthesized
+metadata are the model's dense indices 0..B-1 (so proposals from
+``proposals.diff`` need no renumbering), and ``partition_names[dense_pid]``
+maps the proposal's dense partition id to its ``(topic, partition)``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from cruise_control_tpu.analyzer.proposals import (ExecutionProposal,
+                                                   ReplicaPlacement)
+from cruise_control_tpu.executor.admin import SimulatedClusterAdmin, Tp
+from cruise_control_tpu.executor.executor import Executor
+from cruise_control_tpu.executor.task_manager import ConcurrencyLimits
+from cruise_control_tpu.monitor.metadata import (BrokerInfo, ClusterMetadata,
+                                                 MetadataClient, PartitionInfo)
+
+
+def metadata_from_model(model) -> Tuple[MetadataClient, List[Tp]]:
+    """Synthesize cluster metadata from a tensor model's placement.
+
+    Topics are named ``t<tid>``; partition numbers count up per topic in
+    dense-partition-id order; replica lists are leader-first (the executor's
+    completion check compares replica SETS, but leader-first keeps the
+    synthesized metadata shaped like the reference's).
+    Returns (metadata client, dense partition id → (topic, partition)).
+    """
+    (pr, rb, lead, ptopic, pvalid, bvalid, brack) = jax.device_get((
+        model.partition_replicas, model.replica_broker,
+        model.replica_is_leader, model.partition_topic,
+        model.partition_valid, model.broker_valid, model.broker_rack))
+    brokers = tuple(BrokerInfo(int(b), rack=f"rack{int(brack[b])}",
+                               host=f"host{int(b)}")
+                    for b in range(model.num_brokers) if bvalid[b])
+    parts: List[PartitionInfo] = []
+    partition_names: List[Tp] = []
+    next_index: Dict[int, int] = {}
+    for p in range(pr.shape[0]):
+        tid = int(ptopic[p])
+        topic = f"t{tid}"
+        idx = next_index.get(tid, 0)
+        next_index[tid] = idx + 1
+        partition_names.append((topic, idx))
+        if not pvalid[p]:
+            continue
+        slots = pr[p][pr[p] >= 0]
+        if slots.size == 0:
+            continue
+        placements = [int(rb[r]) for r in slots]
+        leader_pos = next((i for i, r in enumerate(slots) if lead[r]), 0)
+        if leader_pos:
+            placements = [placements[leader_pos]] + \
+                placements[:leader_pos] + placements[leader_pos + 1:]
+        parts.append(PartitionInfo(topic, idx, leader=placements[0],
+                                   replicas=tuple(placements)))
+    mc = MetadataClient(ClusterMetadata(brokers=brokers,
+                                        partitions=tuple(parts)))
+    return mc, partition_names
+
+
+def proposal_bytes_by_tp(proposals: Sequence[ExecutionProposal],
+                         partition_names: Sequence[Tp]) -> Dict[Tp, int]:
+    """Per-partition transfer size for the simulated admin (bytes; the
+    proposal's partition_size is MB)."""
+    return {tuple(partition_names[p.partition]): int(p.partition_size * 1e6)
+            for p in proposals}
+
+
+def sample_move_proposals(model, moves: int = 2,
+                          leadership: int = 1) -> List[ExecutionProposal]:
+    """Small synthetic proposal set for exercising the executor without an
+    optimizer run: ``moves`` replica relocations (last replica of the first
+    eligible partitions moved to the lowest absent broker) plus
+    ``leadership`` leader flips (replica order reversed) on the following
+    partitions.  Placements reflect the model's current state, so they
+    execute cleanly against ``metadata_from_model``'s metadata."""
+    (pr, rb, rd, lead, ptopic, pvalid, bvalid) = jax.device_get((
+        model.partition_replicas, model.replica_broker, model.replica_disk,
+        model.replica_is_leader, model.partition_topic,
+        model.partition_valid, model.broker_valid))
+    alive = [b for b in range(model.num_brokers) if bvalid[b]]
+    out: List[ExecutionProposal] = []
+    want_moves, want_leads = moves, leadership
+    for p in range(pr.shape[0]):
+        if want_moves <= 0 and want_leads <= 0:
+            break
+        if not pvalid[p]:
+            continue
+        slots = pr[p][pr[p] >= 0]
+        if slots.size == 0:
+            continue
+        placements = [ReplicaPlacement(int(rb[r]), int(rd[r])) for r in slots]
+        leader_pos = next((i for i, r in enumerate(slots) if lead[r]), 0)
+        if leader_pos:
+            placements = [placements[leader_pos]] + \
+                placements[:leader_pos] + placements[leader_pos + 1:]
+        old = tuple(placements)
+        size = 100.0
+        if want_moves > 0:
+            used = {pl.broker for pl in old}
+            dest = next((b for b in alive if b not in used), None)
+            if dest is None:
+                continue
+            new = old[:-1] + (ReplicaPlacement(dest, old[-1].disk),)
+            want_moves -= 1
+        elif len(old) > 1:
+            new = tuple(reversed(old))
+            want_leads -= 1
+        else:
+            continue
+        out.append(ExecutionProposal(
+            partition=p, topic=int(ptopic[p]), partition_size=size,
+            old_leader=old[0], old_replicas=old, new_replicas=new))
+    return out
+
+
+def synthetic_health_metrics(stressed_polls=range(6, 12)):
+    """Deterministic broker-health feed for the concurrency adjuster: deep
+    request queues during ``stressed_polls`` (forcing halving), healthy
+    otherwise (doubling back toward the cap) — so simulated executions
+    exercise both adjuster directions reproducibly."""
+    calls = {"n": 0}
+
+    def fn() -> Dict[int, Dict[str, float]]:
+        n = calls["n"]
+        calls["n"] += 1
+        stressed = n in stressed_polls
+        return {0: {
+            "BROKER_REQUEST_QUEUE_SIZE": 5000.0 if stressed else 10.0,
+            "BROKER_REQUEST_HANDLER_POOL_IDLE_PERCENT": 0.9,
+        }}
+    return fn
+
+
+def run_simulated_execution(model_before, proposals: Sequence[ExecutionProposal],
+                            *,
+                            model_after=None,
+                            goal_names: Optional[Sequence[str]] = None,
+                            constraint=None,
+                            balancedness_weights: Tuple[float, float] = (1.1, 1.5),
+                            tick_ms: int = 1000,
+                            rate_bytes_per_sec: float = 50_000_000.0,
+                            limits: Optional[ConcurrencyLimits] = None,
+                            adjuster_churn: bool = True,
+                            ledger_enabled: bool = True,
+                            max_polls: int = 200_000):
+    """Execute ``proposals`` against a simulated fleet derived from
+    ``model_before``.  With ``model_after`` + ``goal_names``, a
+    ``PlacementScorer`` rides along so the ledger records the
+    balancedness-over-time curve.  Returns ``(result, executor, admin)`` —
+    the ledger is ``executor.progress(verbose=True)``; wall-to-balanced is
+    fleet time (``admin.now_ms()``), not host time."""
+    mc, partition_names = metadata_from_model(model_before)
+    admin = SimulatedClusterAdmin(
+        mc, proposal_bytes_by_tp(proposals, partition_names),
+        tick_ms=tick_ms, rate_bytes_per_sec=rate_bytes_per_sec)
+    scorer = None
+    if model_after is not None and goal_names:
+        from cruise_control_tpu.analyzer.optimizer import PlacementScorer
+        scorer = PlacementScorer(model_before, model_after, goal_names,
+                                 constraint, *balancedness_weights)
+    ex = Executor(admin, mc, limits=limits,
+                  clock_ms=admin.now_ms,
+                  ledger_enabled=ledger_enabled,
+                  concurrency_adjuster_interval_ms=0)
+    result = ex.execute_proposals(
+        proposals, partition_names, max_polls=max_polls, poll_interval_s=0.0,
+        replication_throttle=int(rate_bytes_per_sec),
+        concurrency_adjust_metrics=(synthetic_health_metrics()
+                                    if adjuster_churn else None),
+        balancedness_scorer=scorer)
+    return result, ex, admin

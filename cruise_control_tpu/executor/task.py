@@ -11,9 +11,13 @@ from __future__ import annotations
 import dataclasses
 import enum
 import time
-from typing import Optional
+from typing import Callable, Optional
 
 from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+
+#: ``ExecutionProposal.partition_size`` is megabytes; ledger accounting is
+#: bytes so throttle rates (bytes/sec) divide without unit juggling.
+_MB = 1_000_000
 
 
 class TaskType(enum.Enum):
@@ -54,17 +58,26 @@ class ExecutionTask:
     start_time_ms: int = -1
     end_time_ms: int = -1
     alert_time_ms: int = -1
+    # Lifecycle observer (the execution ledger's hook): called after every
+    # state transition as observer(task, old_state, new_state, now_ms).
+    # Excluded from equality/repr — purely observational.
+    observer: Optional[Callable[["ExecutionTask", TaskState, TaskState, int],
+                                None]] = dataclasses.field(
+        default=None, repr=False, compare=False)
 
     def _transition(self, to: TaskState, now_ms: Optional[int] = None) -> None:
         if to not in _VALID_TRANSITIONS[self.state]:
             raise ValueError(f"illegal task transition {self.state} -> {to} "
                              f"(task {self.execution_id})")
+        old = self.state
         self.state = to
         now = now_ms if now_ms is not None else int(time.time() * 1000)
         if to == TaskState.IN_PROGRESS:
             self.start_time_ms = now
         elif to in (TaskState.COMPLETED, TaskState.ABORTED, TaskState.DEAD):
             self.end_time_ms = now
+        if self.observer is not None:
+            self.observer(self, old, to, now)
 
     def in_progress(self, now_ms: Optional[int] = None) -> None:
         self._transition(TaskState.IN_PROGRESS, now_ms)
@@ -85,6 +98,23 @@ class ExecutionTask:
     def is_active(self) -> bool:
         return self.state in (TaskState.PENDING, TaskState.IN_PROGRESS,
                               TaskState.ABORTING)
+
+    @property
+    def bytes_to_move(self) -> int:
+        """Data volume this task transfers, in bytes.
+
+        Inter-broker: the partition's size lands once per NEW destination
+        broker (existing replicas don't re-copy).  Intra-broker: once per
+        disk move.  Leadership: metadata only, zero bytes.
+        """
+        p = self.proposal
+        if self.task_type == TaskType.LEADER_ACTION:
+            return 0
+        if self.task_type == TaskType.INTRA_BROKER_REPLICA_ACTION:
+            n = len(p._intra_broker_moves())
+        else:
+            n = len(p.replicas_to_add)
+        return int(p.partition_size * _MB) * n
 
     def brokers_involved(self):
         """Brokers this task touches (source + destination sets)."""

@@ -63,6 +63,11 @@ class ExecutionTaskManager:
         """Dynamic concurrency adjustment (ConcurrencyAdjuster hook)."""
         self._limits = limits
 
+    def inflight_by_broker(self) -> Dict[int, int]:
+        """Snapshot of per-broker in-flight movement counts (ledger/gauge
+        surface; brokers with zero in-flight are omitted)."""
+        return {b: n for b, n in self._inflight_by_broker.items() if n > 0}
+
     # -- admission ---------------------------------------------------------
     def next_inter_broker_tasks(self) -> List[ExecutionTask]:
         """Next executable inter-broker movements: walk each broker's

@@ -21,6 +21,12 @@ class ReplicationThrottleHelper:
         self._admin = admin
         self._rate = rate_bytes_per_sec
 
+    @property
+    def rate_bytes_per_sec(self) -> Optional[int]:
+        """The configured throttle rate (None = unthrottled) — the execution
+        ledger reads this for its throttle-utilization accounting."""
+        return self._rate
+
     def _throttled_replicas(self, tasks: Sequence[ExecutionTask],
                             partition_names: Sequence[Tp]) -> Dict[str, List[str]]:
         """topic → ["partition:broker", ...] covering old AND new replicas of

@@ -299,6 +299,18 @@ class TensorClusterModel:
             new_offline = self.replica_offline
         return self.replace(broker_state=new_state, replica_offline=new_offline)
 
+    def with_placement(self, replica_broker: Array, replica_is_leader: Array,
+                       replica_disk: Optional[Array] = None) -> "TensorClusterModel":
+        """Swap in a hypothetical replica placement (broker assignment,
+        leadership, optionally disks) keeping every other axis untouched —
+        the executor's balancedness scorer uses this to evaluate blends of
+        the before/after placements as movement batches land."""
+        kwargs = dict(replica_broker=replica_broker,
+                      replica_is_leader=replica_is_leader)
+        if replica_disk is not None:
+            kwargs["replica_disk"] = replica_disk
+        return self.replace(**kwargs)
+
     # ------------------------------------------------------------------
     # Sanity (reference: ClusterModel.sanityCheck, ClusterModel.java:1144)
     # ------------------------------------------------------------------

@@ -117,6 +117,14 @@ def exercise(api, mgr) -> None:
             os.environ.pop("CRUISE_FLIGHT_RECORDER", None)
         else:
             os.environ["CRUISE_FLIGHT_RECORDER"] = saved
+    # Small simulated execution (virtual fleet, synthetic health feed):
+    # registers the execution-ledger families — Executor.* progress gauges,
+    # adjuster-decision counters (both directions), per-type task-duration
+    # histograms — so doc drift on them fails --check-docs.
+    from cruise_control_tpu.executor import simulate as sim
+    model = api.cc.load_monitor.cluster_model()
+    proposals = sim.sample_move_proposals(model, moves=2, leadership=1)
+    sim.run_simulated_execution(model, proposals, tick_ms=200)
     mgr.run_detectors_once(now_ms=1)
 
 

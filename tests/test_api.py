@@ -64,9 +64,12 @@ def test_endpoint_inventory():
     # The reference exposes exactly 20 endpoints (CruiseControlEndPoint.java);
     # this build adds /metrics (the JMX-sensors surface has to live somewhere
     # HTTP-reachable in a JVM-free service), /trace (span traces of admin
-    # operations, keyed by user task), and /flight (the solve flight
-    # recorder's per-step convergence timelines, cut from those traces).
-    assert len(GET_ENDPOINTS - {"metrics", "trace", "flight"}) \
+    # operations, keyed by user task), /flight (the solve flight
+    # recorder's per-step convergence timelines, cut from those traces),
+    # and /executor_state (the execution ledger's progress/curve surface —
+    # the reference folds this into /state's executor substate).
+    assert len(GET_ENDPOINTS - {"metrics", "trace", "flight",
+                                "executor_state"}) \
         + len(POST_ENDPOINTS) == 20
 
 

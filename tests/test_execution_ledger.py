@@ -1,0 +1,212 @@
+"""Execution-ledger tests: lifecycle accounting, the balancedness-over-time
+curve, the /executor_state surface, ledger-off bit-identity, checkpoint
+thinning, and the execution_report tool round-trip.
+"""
+
+import dataclasses
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+from cruise_control_tpu.executor import simulate as sim
+from cruise_control_tpu.executor.ledger import ExecutionLedger
+from cruise_control_tpu.executor.planner import ExecutionTaskPlanner
+from tests.test_executor import build_cluster, make_proposal, monitored, \
+    optimize_proposals
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _optimized_run(seed=3):
+    from cruise_control_tpu.analyzer import optimizer as opt, proposals as props
+    _, lm = monitored(build_cluster(seed=seed))
+    model = lm.cluster_model()
+    goals = ["ReplicaDistributionGoal", "LeaderReplicaDistributionGoal"]
+    run = opt.optimize(model, goals, raise_on_hard_failure=False)
+    return model, run, props.diff(model, run.model), goals
+
+
+def test_ledger_accounting_and_curve():
+    """A real optimized plan executed against the simulated fleet: totals
+    reconcile with the ExecutionResult, off-target bytes shrink monotonely
+    to zero, and the re-scored balancedness converges to the optimizer's
+    post-run score."""
+    model, run, proposals, goals = _optimized_run()
+    assert proposals, "optimizer produced no movements; cluster not skewed?"
+    result, ex, admin = sim.run_simulated_execution(
+        model, proposals, model_after=run.model, goal_names=goals,
+        tick_ms=1000, rate_bytes_per_sec=20_000_000.0)
+    assert result.ok and result.dead == 0 and result.aborted == 0
+
+    prog = ex.progress(verbose=True)
+    assert prog["state"] == "no_task_in_progress"
+    assert prog["ledgerEnabled"] is True
+    # Final counts reconcile with the returned ExecutionResult.
+    assert prog["taskCounts"]["completed"] == result.completed
+    assert prog["taskCounts"]["dead"] == result.dead
+    assert prog["taskCounts"]["aborted"] == result.aborted
+    assert prog["totalTasks"] == result.completed
+    assert prog["bytesMoved"] == prog["totalBytes"] > 0
+    assert prog["bytesInFlight"] == 0
+    assert prog["finishedMs"] is not None
+    assert prog["elapsedMs"] == prog["finishedMs"] - prog["startedMs"]
+    assert admin.now_ms() >= prog["finishedMs"]
+
+    cps = prog["checkpoints"]
+    assert len(cps) >= 2
+    # Hard guarantee: off-target bytes never grow; terminal checkpoint hits 0.
+    off = [c["offTargetBytes"] for c in cps]
+    assert all(b <= a for a, b in zip(off, off[1:]))
+    assert off[-1] == 0
+    assert cps[-1]["completed"] == result.completed
+    # Honest balancedness, re-scored on device: starts at the pre-run score,
+    # converges to the optimizer's post-run score.
+    scored = [c["balancedness"] for c in cps if c["balancedness"] is not None]
+    assert len(scored) >= 2
+    assert abs(scored[0] - run.balancedness_before) < 1e-6
+    assert abs(scored[-1] - run.balancedness_after) < 1e-6
+    assert scored[-1] >= max(scored) - 1e-9
+
+    # Phase trail + per-type durations + adjuster churn (synthetic health
+    # feed stresses then relaxes, so both directions fire).
+    phases = {p["phase"] for p in prog["phases"]}
+    assert "inter_broker" in phases
+    assert prog["taskDurations"]
+    adj = prog["adjusterDecisions"]
+    assert adj["halve"] > 0 and adj["double"] > 0
+
+
+def test_executor_state_endpoint_matches_ledger():
+    """GET /executor_state?verbose progress totals agree with the ledger's
+    final counts after a real (non-dryrun) rebalance through the API."""
+    from tests.test_api import build_stack
+    api, cc, _ = build_stack()
+    status, body, _ = api.handle(
+        "POST", "rebalance", {"dryrun": "false", "max_wait_s": "300"})
+    assert status == 200
+    executed = body["execution"]
+
+    status, state, _ = api.handle("GET", "executor_state",
+                                  {"verbose": "true"})
+    assert status == 200
+    assert state["state"] == "no_task_in_progress"
+    assert state["taskCounts"]["completed"] == executed["completed"]
+    assert state["taskCounts"]["dead"] == executed["dead"]
+    assert state["taskCounts"]["aborted"] == executed["aborted"]
+    assert state["totalTasks"] == sum(
+        executed[k] for k in ("completed", "dead", "aborted"))
+    assert state["bytesMoved"] == state["totalBytes"]
+    # Ledger polls include the per-phase and forced terminal cuts, so they
+    # can only exceed the wait-loop polls the ExecutionResult reports.
+    assert state["polls"] >= executed["polls"]
+    # verbose adds the curve; terminal checkpoint mirrors the final counts.
+    assert state["checkpoints"][-1]["completed"] == executed["completed"]
+    # The facade wires a PlacementScorer, so the curve is scored.
+    assert state["balancedness"] >= 0
+
+    # Non-verbose payload omits the bulky fields but keeps the totals.
+    status, lean, _ = api.handle("GET", "executor_state", {})
+    assert status == 200
+    assert "checkpoints" not in lean and "events" not in lean
+    assert lean["taskCounts"] == state["taskCounts"]
+
+
+def test_ledger_off_bit_identical_result():
+    """ledger_enabled=False must not change execution semantics: the same
+    plan against the same virtual fleet yields an identical
+    ExecutionResult, and progress() degrades to the bare state dict."""
+    model, run, proposals, goals = _optimized_run(seed=5)
+    on, ex_on, _ = sim.run_simulated_execution(
+        model, proposals, tick_ms=500, adjuster_churn=False)
+    off, ex_off, _ = sim.run_simulated_execution(
+        model, proposals, tick_ms=500, adjuster_churn=False,
+        ledger_enabled=False)
+    assert dataclasses.asdict(on) == dataclasses.asdict(off)
+    prog = ex_off.progress(verbose=True)
+    assert prog == {"state": "no_task_in_progress", "ledgerEnabled": False}
+
+
+def test_checkpoint_thinning_and_forced_terminal():
+    """The checkpoint ring stays bounded (thin-by-2, growing stride) and
+    poll(force=True) always lands a terminal checkpoint even when nothing
+    progressed since the last one."""
+    clock = {"t": 0}
+    led = ExecutionLedger(clock_ms=lambda: clock["t"], max_checkpoints=8)
+    plan = ExecutionTaskPlanner().plan(
+        [make_proposal(i, 1.0, old=(0, 1), new=(2, 1)) for i in range(40)])
+    led.attach(plan)
+    for t in plan.inter_broker_tasks:
+        clock["t"] += 1000
+        t.in_progress()
+        t.completed()
+        led.poll()
+    assert len(led.checkpoints) <= 8
+    # Stride grew past 1, so surviving checkpoints are spaced out.
+    assert led._stride > 1
+    polls = [c["poll"] for c in led.checkpoints]
+    assert polls == sorted(polls)
+    # Stride sampling may have skipped the tail; the forced terminal poll
+    # (what the executor's final block issues) lands the end state.
+    led.finished()
+    led.poll(force=True)
+    assert led.checkpoints[-1]["completed"] == 40
+    assert led.checkpoints[-1]["offTargetBytes"] == 0
+    # Once the curve reflects the terminal state, further polls are no-ops.
+    n = len(led.checkpoints)
+    led.poll(force=True)
+    assert len(led.checkpoints) == n
+
+
+def test_execution_report_roundtrip(tmp_path):
+    """A verbose ledger dump survives the trip through
+    tools/execution_report.py: the tool parses it, confirms monotone
+    off-target progress, and reports the same totals."""
+    _, lm = monitored(build_cluster())
+    model = lm.cluster_model()
+    proposals = sim.sample_move_proposals(model, moves=2, leadership=1)
+    result, ex, _ = sim.run_simulated_execution(model, proposals, tick_ms=200)
+    prog = ex.progress(verbose=True)
+    dump = tmp_path / "dump.json"
+    dump.write_text(json.dumps(prog))
+
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "execution_report.py"),
+         "--json", str(dump)],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    rep = json.loads(out.stdout.strip())
+    assert rep["source"] == "ledger_dump"
+    assert rep["off_target_monotone"] is True
+    assert rep["checkpoints"] == len(prog["checkpoints"])
+    assert rep["total_bytes"] == prog["totalBytes"]
+    assert rep["task_counts"]["completed"] == result.completed
+
+
+def test_execution_report_reads_bench_artifact():
+    """The same report builder normalizes a bench.py --execute artifact
+    (curve + plan + result) without a subprocess."""
+    sys.path.insert(0, str(REPO))
+    from tools.execution_report import build_report
+    artifact = {
+        "metric": "execution_wall_to_balanced_mid",
+        "curve": [
+            {"tMs": 0, "bytesMoved": 0, "offTargetBytes": 100,
+             "balancedness": 10.0},
+            {"tMs": 1000, "bytesMoved": 60, "offTargetBytes": 40,
+             "balancedness": 55.0},
+            {"tMs": 2000, "bytesMoved": 100, "offTargetBytes": 0,
+             "balancedness": 98.0},
+        ],
+        "plan": {"totalTasks": 3, "totalBytes": 100},
+        "result": {"completed": 3, "dead": 0, "aborted": 0},
+        "wall_to_balanced_s": 2.0,
+        "proposals_per_sec": 1.5,
+        "balancedness_final": 98.0,
+    }
+    rep = build_report(artifact)
+    assert rep["source"] == "execution_wall_to_balanced_mid"
+    assert rep["off_target_monotone"] is True
+    assert rep["balancedness_converged"] is True
+    assert rep["total_bytes"] == 100
+    assert rep["wall_to_balanced_s"] == 2.0

@@ -280,3 +280,104 @@ def test_env_substitution_in_properties(tmp_path, monkeypatch):
     props = load_properties(str(p))
     assert props["bootstrap.servers"] == "broker1:9092"
     assert props["webserver.http.address"] == ""
+
+
+# -- concurrency adjuster ----------------------------------------------------
+
+def _adjuster(inter=8, **kw):
+    from cruise_control_tpu.executor.executor import ConcurrencyAdjuster
+    base = ConcurrencyLimits(inter_broker_per_broker=inter)
+    return ConcurrencyAdjuster(base, **kw), base
+
+
+_HEALTHY = {0: {"BROKER_REQUEST_QUEUE_SIZE": 10.0,
+                "BROKER_REQUEST_HANDLER_POOL_IDLE_PERCENT": 0.9}}
+
+
+def test_adjuster_halves_on_deep_request_queue():
+    adj, base = _adjuster(8)
+    deep = {0: {"BROKER_REQUEST_QUEUE_SIZE": 5000.0,
+                "BROKER_REQUEST_HANDLER_POOL_IDLE_PERCENT": 0.9}}
+    lim = adj.adjust(base, deep)
+    assert lim.inter_broker_per_broker == 4
+    lim = adj.adjust(lim, deep)
+    assert lim.inter_broker_per_broker == 2
+    for _ in range(5):
+        lim = adj.adjust(lim, deep)
+    assert lim.inter_broker_per_broker == 1  # floored at min_per_broker
+
+
+def test_adjuster_halves_on_low_idle_ratio_and_min_isr():
+    adj, base = _adjuster(8)
+    # Any single stressed broker among healthy ones trips the halving.
+    mixed = dict(_HEALTHY)
+    mixed[1] = {"BROKER_REQUEST_QUEUE_SIZE": 10.0,
+                "BROKER_REQUEST_HANDLER_POOL_IDLE_PERCENT": 0.1}
+    assert adj.adjust(base, mixed).inter_broker_per_broker == 4
+    # (At/Under)MinISR pressure halves even with healthy broker metrics.
+    adj2, base2 = _adjuster(8)
+    lim = adj2.adjust(base2, _HEALTHY, has_min_isr_pressure=True)
+    assert lim.inter_broker_per_broker == 4
+    # No metrics at all + no pressure = healthy (hold at the cap).
+    adj3, base3 = _adjuster(8)
+    assert adj3.adjust(base3, {}).inter_broker_per_broker == 8
+
+
+def test_adjuster_doubles_back_to_cap_when_healthy():
+    adj, base = _adjuster(8)
+    lim = dataclasses.replace(base, inter_broker_per_broker=1)
+    seen = []
+    for _ in range(5):
+        lim = adj.adjust(lim, _HEALTHY)
+        seen.append(lim.inter_broker_per_broker)
+    # Doubles each evaluation, then holds at the configured cap.
+    assert seen == [2, 4, 8, 8, 8]
+
+
+def test_adjuster_ceiling_respects_max_per_broker():
+    adj, base = _adjuster(8, max_per_broker=4)
+    lim = dataclasses.replace(base, inter_broker_per_broker=1)
+    for _ in range(4):
+        lim = adj.adjust(lim, _HEALTHY)
+    assert lim.inter_broker_per_broker == 4
+
+
+def test_adjuster_interval_gating():
+    import time as _time
+    adj, base = _adjuster(8, interval_ms=3_600_000)
+    deep = {0: {"BROKER_REQUEST_QUEUE_SIZE": 5000.0}}
+    # Pretend the last evaluation just happened: within the interval the
+    # adjuster returns the limits untouched.
+    adj._last_adjust_ms = _time.monotonic() * 1000
+    lim = adj.adjust(base, deep)
+    assert lim.inter_broker_per_broker == 8
+    # Expire the interval; the same stressed feed now halves.
+    adj._last_adjust_ms -= 3_600_001
+    lim = adj.adjust(lim, deep)
+    assert lim.inter_broker_per_broker == 4
+
+
+# -- removed/demoted broker history gc ---------------------------------------
+
+def test_recently_removed_and_demoted_broker_expiry():
+    md = build_cluster()
+    mc = MetadataClient(md)
+    ex = Executor(InMemoryClusterAdmin(mc, latency_polls=1), mc,
+                  removed_broker_retention_ms=1000,
+                  demoted_broker_retention_ms=500)
+    ex.add_recently_removed_brokers([1, 2], now_ms=0)
+    ex.add_recently_demoted_brokers([3], now_ms=0)
+    # Inside both retention windows.
+    assert ex.recently_removed_brokers(now_ms=400) == {1, 2}
+    assert ex.recently_demoted_brokers(now_ms=400) == {3}
+    # Demoted retention (500ms) is shorter than removed (1000ms).
+    assert ex.recently_demoted_brokers(now_ms=501) == set()
+    assert ex.recently_removed_brokers(now_ms=501) == {1, 2}
+    # Exactly at the boundary the entry survives (expiry is strict >).
+    assert ex.recently_removed_brokers(now_ms=1000) == {1, 2}
+    assert ex.recently_removed_brokers(now_ms=1001) == set()
+    # A refreshed timestamp restarts the clock; explicit drop removes now.
+    ex.add_recently_removed_brokers([4], now_ms=2000)
+    ex.add_recently_removed_brokers([5], now_ms=2000)
+    ex.drop_recently_removed_brokers([5])
+    assert ex.recently_removed_brokers(now_ms=2500) == {4}

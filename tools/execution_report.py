@@ -1,0 +1,151 @@
+"""Render an execution ledger's time-to-balanced curve; summarize a run.
+
+The execution ledger (executor/ledger.py) checkpoints bytes-moved /
+off-target bytes / balancedness as a proposal plan executes.  This tool
+turns a ledger dump into something a human (ASCII curve + phase/duration
+rollup) or a later revision (``--json`` one-liner) can read:
+
+- ``python tools/execution_report.py EXEC_mid.json``     render a bench
+  artifact (bench.py --execute)
+- ``python tools/execution_report.py dump.json``         render a raw ledger
+  dump (``GET /executor_state?verbose=true`` body, or
+  ``executor.progress(verbose=True)`` saved as JSON)
+- ``--json`` emits the report as one JSON line instead of the curves.
+
+Both shapes normalize to the same report: checkpoints come from the
+artifact's ``curve`` or the dump's ``checkpoints``; the monotone progress
+guarantee is ``offTargetBytes`` (total - moved, which can only shrink) while
+``balancedness`` is the honest re-scored value (transient dips are real).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_BAR_W = 40
+
+
+def normalize(record: dict) -> dict:
+    """Common shape from an EXEC artifact or a raw ledger dump."""
+    if "curve" in record:  # bench.py --execute artifact
+        plan = record.get("plan", {})
+        return {
+            "source": record.get("metric", "exec_artifact"),
+            "curve": list(record["curve"]),
+            "total_bytes": int(plan.get("totalBytes", 0)),
+            "task_counts": dict(record.get("result", {})),
+            "phases": list(record.get("phases", [])),
+            "task_durations_ms": dict(record.get("task_durations_ms", {})),
+            "adjuster_decisions": dict(record.get("adjuster_decisions", {})),
+            "wall_to_balanced_s": record.get("wall_to_balanced_s"),
+            "proposals_per_sec": record.get("proposals_per_sec"),
+            "balancedness_final": record.get("balancedness_final"),
+        }
+    if "checkpoints" not in record:
+        raise SystemExit(
+            "unrecognized record: need an EXEC_*.json artifact ('curve') or "
+            "a verbose ledger dump ('checkpoints' — did you forget "
+            "?verbose=true on /executor_state?)")
+    elapsed = record.get("elapsedMs")
+    return {
+        "source": "ledger_dump",
+        "curve": list(record["checkpoints"]),
+        "total_bytes": int(record.get("totalBytes", 0)),
+        "task_counts": dict(record.get("taskCounts", {})),
+        "phases": list(record.get("phases", [])),
+        "task_durations_ms": dict(record.get("taskDurations", {})),
+        "adjuster_decisions": dict(record.get("adjusterDecisions", {})),
+        "wall_to_balanced_s": (elapsed / 1000.0
+                               if elapsed is not None else None),
+        "proposals_per_sec": None,
+        "balancedness_final": record.get("balancedness"),
+    }
+
+
+def build_report(record: dict) -> dict:
+    n = normalize(record)
+    curve = n["curve"]
+    off = [c.get("offTargetBytes") for c in curve
+           if c.get("offTargetBytes") is not None]
+    scored = [c.get("balancedness") for c in curve
+              if c.get("balancedness") is not None]
+    n["checkpoints"] = len(curve)
+    # The ledger's hard guarantee: off-target bytes never grow.
+    n["off_target_monotone"] = all(b <= a for a, b in zip(off, off[1:]))
+    n["balancedness_converged"] = (bool(scored)
+                                   and scored[-1] >= max(scored) - 1e-9)
+    return n
+
+
+def _bar(v: float, vmax: float) -> str:
+    if vmax <= 0:
+        return ""
+    return "#" * max(1 if v > 0 else 0, round(_BAR_W * v / vmax))
+
+
+def print_report(rep: dict) -> None:
+    total = rep["total_bytes"]
+    print(f"source={rep['source']} totalBytes={total} "
+          f"checkpoints={rep['checkpoints']}")
+    if rep["wall_to_balanced_s"] is not None:
+        pps = rep["proposals_per_sec"]
+        print(f"wall-to-balanced: {rep['wall_to_balanced_s']:.1f}s"
+              + (f"  ({pps:.1f} proposals/s)" if pps else ""))
+    print()
+    print(f"{'t(s)':>8} {'moved%':>7} {'balancedness':>12}  progress")
+    for c in rep["curve"]:
+        t = c.get("tMs", 0) / 1000.0
+        moved = c.get("bytesMoved", 0)
+        pct = 100.0 * moved / total if total else 0.0
+        bal = c.get("balancedness")
+        bal_s = "-" if bal is None else f"{bal:.2f}"
+        print(f"{t:>8.1f} {pct:>6.1f}% {bal_s:>12}  {_bar(moved, total)}")
+    print()
+    if rep["phases"]:
+        print("phases:")
+        for p in rep["phases"]:
+            dur = (p.get("endMs", 0) - p.get("startMs", 0)) / 1000.0
+            print(f"  {p['phase']:<14} {dur:>8.1f}s polls={p.get('polls', 0)} "
+                  f"batches={p.get('batches', 0)}")
+    if rep["task_durations_ms"]:
+        print("task durations:")
+        for tt, d in sorted(rep["task_durations_ms"].items()):
+            print(f"  {tt:<28} n={d.get('count', 0):<5} "
+                  f"mean={d.get('meanMs', 0) / 1000.0:.1f}s "
+                  f"max={d.get('maxMs', 0) / 1000.0:.1f}s")
+    if rep["adjuster_decisions"]:
+        a = rep["adjuster_decisions"]
+        print(f"adjuster: halve={a.get('halve', 0)} "
+              f"double={a.get('double', 0)} hold={a.get('hold', 0)}")
+    print(f"off_target_monotone: {rep['off_target_monotone']}  "
+          f"balancedness_converged: {rep['balancedness_converged']}")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("record",
+                    help="EXEC_*.json artifact or verbose ledger dump")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as one JSON line (no curves)")
+    args = ap.parse_args()
+    with open(args.record) as f:
+        text = f.read().strip()
+    try:
+        record = json.loads(text)
+    except json.JSONDecodeError:
+        # bench output is .jsonl (one record per line, last wins)
+        record = json.loads(text.splitlines()[-1])
+    rep = build_report(record)
+    if args.json:
+        print(json.dumps(rep), flush=True)
+    else:
+        print_report(rep)
+
+
+if __name__ == "__main__":
+    main()

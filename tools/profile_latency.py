@@ -64,6 +64,38 @@ def main():
     print(f"TOTAL wall={wall:.3f}s span={root['durationMs']:.1f}ms "
           f"steps={tot_steps} per-step={wall/max(tot_steps,1)*1000:.1f}ms")
 
+    # Executor per-phase rollup: drive a small simulated execution and read
+    # back the executor.* spans (the same executor.execute ->
+    # executor.<phase> tree the /trace endpoint serves).  A dedicated small
+    # cluster with spare brokers guarantees real inter-broker moves at any
+    # BENCH_SCALE (the 3-broker rf=3 small rung has nowhere to move to).
+    from cruise_control_tpu.executor import simulate as sim
+    espec = ClusterSpec(num_brokers=6, num_racks=3, num_topics=3,
+                        mean_partitions_per_topic=8.0, replication_factor=2,
+                        distribution="exponential", seed=7)
+    emodel = generate_cluster(espec)
+    proposals = sim.sample_move_proposals(emodel, moves=4, leadership=2)
+    TRACE.reset()
+    sim.run_simulated_execution(emodel, proposals, tick_ms=100)
+    traces = TRACE.recent(1)
+    if not traces or traces[0]["name"] != "executor.execute":
+        print("ERROR: no executor.execute trace recorded", file=sys.stderr)
+        sys.exit(1)
+    eroot = traces[0]
+    ea = eroot.get("attrs", {})
+    print(f"\nexecutor phases ({ea.get('proposals', len(proposals))} proposals,"
+          f" simulated fleet):")
+    for span in eroot.get("children", []):
+        if not span["name"].startswith("executor."):
+            continue
+        a = span.get("attrs", {})
+        extra = " ".join(f"{k}={a[k]}" for k in
+                         ("tasks", "polls", "batches", "bytes_moved")
+                         if k in a)
+        print(f"  {span['name']:28s} dur={span['durationMs']:8.1f}ms {extra}")
+    print(f"  executor.execute total dur={eroot['durationMs']:.1f}ms "
+          f"bytes_moved={ea.get('bytes_moved')} of {ea.get('bytes_total')}")
+
 
 if __name__ == "__main__":
     main()
