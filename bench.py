@@ -397,6 +397,177 @@ def run_execute_rung(scale: str, max_candidates, fast: bool) -> dict:
     return rec
 
 
+def run_warm_rung(scale: str, max_candidates, fast: bool) -> dict:
+    """--warm: cruise-mode warm-start rung.  Solve the rung cold once, then
+    replay a stream of small perturbations (≤5% of brokers get a ±10% load
+    tick); each perturbed model is solved BOTH cold (from zero) and warm
+    (seeded from the previous converged placement via the same
+    ``WarmStart`` the facade's standing-proposal path builds).  Records
+    cold-vs-warm wall/steps/fetches and writes WARM_<rung>.json with both
+    flight timelines (tools/flight_report.py renders the overlay).  Warm
+    proposals must be verifier-clean and equisatisfying — a warm solve
+    that satisfies less than its cold twin fails the rung."""
+    brokers, racks, topics, ppt, rf = SCALES[scale]
+
+    import jax
+    import numpy as np
+
+    from cruise_control_tpu.analyzer import optimizer as opt
+    from cruise_control_tpu.analyzer import proposals as props
+    from cruise_control_tpu.analyzer.state import WarmStart, model_delta
+    from cruise_control_tpu.analyzer.verifier import verify_run
+    from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster
+
+    spec = ClusterSpec(num_brokers=brokers, num_racks=racks, num_topics=topics,
+                       mean_partitions_per_topic=ppt, replication_factor=rf,
+                       distribution="exponential", seed=2026)
+    model = jax.device_put(generate_cluster(spec))
+    jax.block_until_ready(model)
+    num_replicas = int(model.replica_valid.sum())
+
+    def solve(m, warm_start=None):
+        disp0 = dict(opt.FETCH_COUNTERS)
+        t0 = time.monotonic()
+        # fuse_group_size=1 selects the per-goal path whose fused
+        # satisfaction sweep is what lets a warm solve skip already-clean
+        # goals outright — the same path the service uses at scale.
+        run = opt.optimize(opt.donation_copy(m), STACK,
+                           raise_on_hard_failure=False, fused=True,
+                           fuse_group_size=1,
+                           max_candidates_per_step=max_candidates,
+                           fast_mode=fast, donate_model=True,
+                           warm_start=warm_start)
+        wall = time.monotonic() - t0
+        fetches = {k: opt.FETCH_COUNTERS[k] - disp0[k] for k in disp0}
+        return run, wall, fetches
+
+    rng = np.random.default_rng(7)
+    frac = 0.05
+
+    def perturb(m):
+        """One metric tick: partitions led from ≤5% of brokers get a ±10%
+        traffic change — the cruise loop's steady-state input.  Load is a
+        partition property (generator.py builds sibling leader/follower
+        rows from one per-partition row), so the factor applies to every
+        replica of a touched partition; perturbing siblings unequally
+        would let leadership transfers change cluster totals."""
+        k = max(1, int(m.num_brokers * frac))
+        chosen = np.sort(np.asarray(rng.choice(m.num_brokers, size=k,
+                                               replace=False)))
+        rb = np.asarray(m.replica_broker)
+        rp = np.asarray(m.replica_partition)
+        lead = np.asarray(m.replica_is_leader) & np.asarray(m.replica_valid)
+        ll = np.array(m.replica_load_leader)
+        lf = np.array(m.replica_load_follower)
+        hot = np.zeros(m.num_partitions, dtype=bool)
+        hot[rp[lead & np.isin(rb, chosen)]] = True
+        factor = np.ones((m.num_partitions, 1), dtype=ll.dtype)
+        factor[hot] = rng.uniform(0.9, 1.1, size=(int(hot.sum()), 1))
+        ll *= factor[rp]
+        lf *= factor[rp]
+        import jax.numpy as jnp
+        return m.replace(replica_load_leader=jnp.asarray(ll),
+                         replica_load_follower=jnp.asarray(lf)), chosen
+
+    # Base solve: compiles every per-goal program + the fused sweep (the
+    # warm path adds NO compiled graphs) and produces the converged
+    # placement the stream warms from.
+    base_run, _, _ = solve(model)
+    prev_converged = base_run.model
+
+    stream = []
+    cold_total = warm_total = 0.0
+    cold_run = warm_run = None
+    cold_wall = warm_wall = 0.0
+    cold_f = warm_f = {}
+    for i in range(int(os.environ.get("BENCH_WARM_PERTURBATIONS", "3"))):
+        model, changed = perturb(model)
+        jax.block_until_ready(model)
+        cold_run, cold_wall, cold_f = solve(model)
+        # The same probe the facade's standing-proposal consult runs: the
+        # changed mask covers the perturbed brokers ∪ the brokers the
+        # previous converged placement moved.
+        delta = model_delta(prev_converged, model)
+        ws = WarmStart(prev_model=prev_converged,
+                       active_mask=(delta.changed_mask
+                                    if delta is not None else None))
+        warm_run, warm_wall, warm_f = solve(model, warm_start=ws)
+        # Verifier-clean warm proposals (raises on violation → rung fails
+        # inside its watchdog rather than recording a bad artifact).
+        warm_props = props.diff(model, warm_run.model)
+        verify_run(model, warm_run,
+                   [g.name for g in warm_run.goal_results],
+                   proposals=warm_props)
+        cold_sat = {g.name: g.satisfied_after for g in cold_run.goal_results}
+        warm_sat = {g.name: g.satisfied_after for g in warm_run.goal_results}
+        equisat = all(warm_sat[name] for name, ok in cold_sat.items() if ok)
+        if not equisat:
+            raise SystemExit(
+                f"warm solve under-satisfied vs cold on perturbation {i}: "
+                f"cold={cold_sat} warm={warm_sat}")
+        cold_total += cold_wall
+        warm_total += warm_wall
+        stream.append({
+            "perturbed_brokers": [int(b) for b in changed],
+            "delta_magnitude": (round(delta.magnitude, 6)
+                                if delta is not None else None),
+            "cold_wall_s": round(cold_wall, 3),
+            "warm_wall_s": round(warm_wall, 3),
+            "cold_steps": sum(g.steps for g in cold_run.goal_results),
+            "warm_steps": sum(g.steps for g in warm_run.goal_results),
+            "cold_fetches": cold_f["device_fetches"],
+            "warm_fetches": warm_f["device_fetches"],
+            "warm_goals_skipped": warm_run.goals_skipped,
+            "warm_seed_frontier_size": warm_run.seed_frontier_size,
+            "equisatisfying": equisat,
+        })
+        prev_converged = warm_run.model
+
+    def side(run, wall, fetches):
+        return {
+            "wall_s": round(wall, 3),
+            "steps": sum(g.steps for g in run.goal_results),
+            "actions": sum(g.actions_applied for g in run.goal_results),
+            "fetches": fetches["device_fetches"],
+            "goals_skipped": run.goals_skipped,
+            "seed_frontier_size": run.seed_frontier_size,
+            "per_goal": {g.name: {
+                "steps": g.steps, "actions": g.actions_applied,
+                "wall_s": round(g.duration_s, 3),
+                "satisfied_after": g.satisfied_after,
+                **({"flight": g.flight} if g.flight is not None else {}),
+            } for g in run.goal_results},
+        }
+
+    speedup = cold_total / max(warm_total, 1e-9)
+    rec = {
+        "metric": f"warm_vs_cold_speedup_{scale}",
+        "value": round(speedup, 2),
+        "unit": "x",
+        # Acceptance bar: warm ≥ 3× faster than cold over the stream.
+        "vs_baseline": round(speedup / 3.0, 3),
+        "num_brokers": brokers,
+        "num_replicas": num_replicas,
+        "perturbed_broker_frac": frac,
+        "perturbations": len(stream),
+        "cold_wall_s": round(cold_total, 3),
+        "warm_wall_s": round(warm_total, 3),
+        "stream": stream,
+        # Last perturbation's full cold/warm records (flight timelines
+        # included when the recorder is on) — the overlay's two sides.
+        "cold": side(cold_run, cold_wall, cold_f),
+        "warm": side(warm_run, warm_wall, warm_f),
+        **({"fast_mode": True} if fast else {}),
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        f"WARM_{scale}.json")
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1, sort_keys=True)
+        f.write("\n")
+    rec["warm_artifact"] = os.path.basename(path)
+    return rec
+
+
 def main() -> None:
     # Rung selection: --rungs flag > BENCH_SCALE env > default small,mid.
     # The default deliberately stops at mid (~10k replicas): it is the
@@ -424,10 +595,18 @@ def main() -> None:
                          "a real proposal plan, execute it against the "
                          "simulated fleet, write EXEC_<rung>.json "
                          "(default rung: mid)")
+    ap.add_argument("--warm", action="store_true",
+                    help="run the warm-start rung(s) instead: replay a "
+                         "stream of small perturbations solved cold AND "
+                         "warm (seeded from the previous converged "
+                         "placement), write WARM_<rung>.json with both "
+                         "flight timelines (default rung: mid)")
     args = ap.parse_args()
-    if args.flight:
+    if args.flight or args.warm:
+        # --warm always records flight telemetry: the WARM artifact's whole
+        # point is the cold-vs-warm convergence overlay.
         os.environ["CRUISE_FLIGHT_RECORDER"] = "1"
-    default_rungs = "mid" if args.execute else "small,mid"
+    default_rungs = "mid" if (args.execute or args.warm) else "small,mid"
     scale_sel = args.rungs or os.environ.get("BENCH_SCALE") or default_rungs
     scales = (["small", "mid", "large"] if scale_sel == "ladder"
               else [s.strip() for s in scale_sel.split(",") if s.strip()])
@@ -464,10 +643,12 @@ def main() -> None:
         # harness' TERM (or the total-budget watchdog) arrives.  Exercised
         # by the suite; never set in real runs.
         metric = ("execution_wall_to_balanced_small" if args.execute
+                  else "warm_vs_cold_speedup_small" if args.warm
                   else "wall_clock_to_goal_satisfying_proposal_small")
         _record_rung({"metric": metric, "value": 0.0, "unit": "s",
                       "vs_baseline": 0.0, "selftest": True,
-                      **({"execute": True} if args.execute else {})})
+                      **({"execute": True} if args.execute else {}),
+                      **({"warm": True} if args.warm else {})})
         while True:
             signal.pause()
 
@@ -485,6 +666,7 @@ def main() -> None:
     for s in scales:
         cancel = _watchdog(rung_timeout, f"rung_timeout_{s}")
         rec = (run_execute_rung(s, max_candidates, fast) if args.execute
+               else run_warm_rung(s, max_candidates, fast) if args.warm
                else run_rung(s, max_candidates, fast))
         cancel()
         rec["backend"] = platform

@@ -58,7 +58,8 @@ from cruise_control_tpu.analyzer.state import (FLIGHT_ACTIONS, FLIGHT_BISECT,
                                                PACKED_CAPPED, BrokerArrays,
                                                FrontierInvariants,
                                                OptimizationOptions,
-                                               StepInvariants, pow2_bucket)
+                                               StepInvariants, WarmStart,
+                                               pow2_bucket)
 from cruise_control_tpu.common import compile_cache
 from cruise_control_tpu.common.sensors import SENSORS
 from cruise_control_tpu.common.tracing import TRACE
@@ -1559,7 +1560,8 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
                       max_steps: int = 256, chunk_steps: int = 32,
                       mesh=None, donate: bool = False, frontier: bool = True,
                       tail_threshold: float = 0.1, min_chunk: int = 4,
-                      on_chunk=None, speculate: Optional[bool] = None):
+                      on_chunk=None, speculate: Optional[bool] = None,
+                      seed_active=None):
     """Async chunked driver for one goal's fixpoint.  Returns
     ``(model, info)`` where info = {chunks, buckets, fresh_compile, steps,
     actions, satisfied_before, satisfied_after, capped, repair_steps,
@@ -1615,6 +1617,15 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
     timeline whose entries point at their chunk record (wall, bucket,
     length, fresh_compile).  Discarded speculative chunks recorded into
     their own buffer, which is simply never fetched.
+
+    ``seed_active`` (bool[B] host numpy, warm-start seeding) pre-builds the
+    FIRST dispatch's frontier from the given mask instead of starting
+    dense: when the mask buckets under the frontier policy, the opening
+    chunk already runs compacted over the seed brokers.  Sound for the same
+    reason as any compacted chunk — a compacted convergence is confirmed by
+    a dense chunk before the goal is declared done, so a mask that misses a
+    needed broker costs one confirm chunk, never correctness.  ``None``
+    leaves the driver's behavior bit-identical to the unseeded path.
     """
     ns = num_sources or cgen.default_num_sources(model)
     nd = num_dests or cgen.default_num_dests(model)
@@ -1650,6 +1661,14 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
     force_dense = not use_frontier
     bucket: Optional[int] = None  # config of the next host-decided dispatch
     fr: Optional[FrontierInvariants] = None
+    seeded = 0
+    if use_frontier and seed_active is not None:
+        seed_np = np.asarray(seed_active, dtype=bool)
+        nb = _frontier_bucket(int(seed_np.sum()), B)
+        if nb is not None:
+            bucket = nb
+            fr = _build_frontier(seed_np, nb)
+            seeded = int(seed_np.sum())
     pending: Optional[dict] = None  # the one in-flight speculative chunk
     t_prev = time.monotonic()
 
@@ -1838,6 +1857,8 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
             "lanes_live": lanes_total, "fetches": fetches,
             "fetch_wait_s": fetch_wait, "chunks_speculative": speculated,
             "chunks_wasted": wasted}
+    if seeded:
+        info["seed_frontier"] = seeded
     if flight_cap:
         info["flight"] = {"kinds": list(FLIGHT_KINDS),
                           "steps": flight_steps, "chunks": flight_chunks}
@@ -2097,6 +2118,27 @@ def _push_flight_sensors(goal_name: str, flight: dict) -> None:
     ).set(to90)
 
 
+def _push_warm_sensors(seed_frontier_size: int, goals_skipped: int) -> None:
+    """Warm-start counters into the sensor registry — one report per warm
+    ``_optimize`` pass (cruise mode / warm facade requests)."""
+    SENSORS.counter(
+        "GoalOptimizer.warm-start-solves",
+        help="Optimization passes seeded from a previously-converged "
+             "placement",
+    ).inc(1)
+    SENSORS.counter(
+        "GoalOptimizer.warm-start-goals-skipped",
+        help="Goals skipped outright because the seeded placement still "
+             "passed their fused satisfaction sweep",
+    ).inc(goals_skipped)
+    SENSORS.histogram(
+        "GoalOptimizer.warm-start-seed-frontier-size",
+        buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+        help="Brokers in the warm seed frontier mask (changed union "
+             "previously-active); 0 when the solve ran dense",
+    ).observe(seed_frontier_size)
+
+
 _stack_cache: Dict[tuple, object] = {}
 
 
@@ -2183,6 +2225,13 @@ class OptimizerRun:
     # violated, each violated goal subtracts its priority/strictness cost.
     balancedness_before: float = 100.0
     balancedness_after: float = 100.0
+    # Warm-start accounting (cruise mode): whether this pass was seeded
+    # from a previously-converged placement, how many brokers the seed
+    # frontier mask covered (0 = no mask / dense), and how many goals the
+    # fused sweep skipped outright on the seeded placement.
+    warm: bool = False
+    seed_frontier_size: int = 0
+    goals_skipped: int = 0
 
     @property
     def violated_goals_before(self) -> List[str]:
@@ -2221,7 +2270,8 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
              balancedness_priority_weight: float = 1.1,
              balancedness_strictness_weight: float = 1.5,
              mesh=None, donate_model: bool = False,
-             frontier: Optional[bool] = None) -> OptimizerRun:
+             frontier: Optional[bool] = None,
+             warm_start: Optional[WarmStart] = None) -> OptimizerRun:
     """Traced entry point around ``_optimize`` (see its docstring for the
     optimization semantics): the whole pass runs inside an
     ``analyzer.optimize`` span, and each goal's fixpoint stats (steps,
@@ -2242,7 +2292,11 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
                         balancedness_priority_weight=balancedness_priority_weight,
                         balancedness_strictness_weight=balancedness_strictness_weight,
                         mesh=mesh, donate_model=donate_model,
-                        frontier=frontier)
+                        frontier=frontier, warm_start=warm_start)
+        warm_attrs = ({"warm": True,
+                       "seed_frontier_size": run.seed_frontier_size,
+                       "goals_skipped": run.goals_skipped}
+                      if run.warm else {})
         for g in run.goal_results:
             TRACE.record("analyzer.goal", g.duration_s, goal=g.name,
                          steps=g.steps, actions=g.actions_applied,
@@ -2254,6 +2308,7 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
                          fetches=g.fetches,
                          chunks_speculative=g.chunks_speculative,
                          chunks_wasted=g.chunks_wasted,
+                         **warm_attrs,
                          **({"flight": g.flight}
                             if g.flight is not None else {}))
         sp.annotate(actions=sum(g.actions_applied for g in run.goal_results),
@@ -2277,7 +2332,8 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
               balancedness_priority_weight: float = 1.1,
               balancedness_strictness_weight: float = 1.5,
               mesh=None, donate_model: bool = False,
-              frontier: Optional[bool] = None) -> OptimizerRun:
+              frontier: Optional[bool] = None,
+              warm_start: Optional[WarmStart] = None) -> OptimizerRun:
     """Run the goal stack in priority order (GoalOptimizer.optimizations).
 
     Each goal optimizes the model to its fixpoint, constrained by the
@@ -2321,10 +2377,35 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
     dense path, True forces the frontier policy (still dense below the
     floor and for non-band goals).  The multi-goal-chunk and unfused paths
     always run dense.
+
+    ``warm_start`` seeds the solve from a previously-converged placement
+    (cruise mode): the fresh model's replica placement is re-based onto
+    ``warm_start.prev_model``'s converged arrays (copied — the donation
+    path would otherwise consume the caller's standing buffers), and
+    ``warm_start.active_mask`` restricts each goal's INITIAL frontier to
+    changed ∪ previously-active brokers.  Correctness does not rest on the
+    mask: the frontier driver always confirms compacted convergence with a
+    dense chunk.  Goals the seeded placement already satisfies fall out of
+    the existing fused-sweep skip.  Incompatible warm starts (shape or
+    membership drift) silently fall back to the cold path; ``None`` keeps
+    every code path bit-identical to a cold solve.
     """
     constraint = constraint or BalancingConstraint.default()
     options = options if options is not None else OptimizationOptions.none(model)
     specs = goals_by_priority(goal_names)
+    warm = False
+    seed_mask: Optional[np.ndarray] = None
+    if warm_start is not None and warm_start.compatible_with(model):
+        prev_pl = warm_start.prev_model
+        # jnp.array COPIES: the seeded dispatch may donate its input
+        # buffers, and the standing model must survive for the next delta.
+        model = model.replace(
+            replica_broker=jnp.array(prev_pl.replica_broker),
+            replica_is_leader=jnp.array(prev_pl.replica_is_leader),
+            replica_disk=jnp.array(prev_pl.replica_disk))
+        warm = True
+        if warm_start.active_mask is not None:
+            seed_mask = np.asarray(warm_start.active_mask, dtype=bool)
     dests_pinned = num_dests is not None
     if fast_mode:
         num_sources = min(max(32, (num_sources or cgen.default_num_sources(model)) // 2),
@@ -2373,6 +2454,7 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
             "%d -> %d, num_dests %d -> %d (set CRUISE_TPU_COMPILE_CEILING="
             "off to disable)", ceiling, ns0, ns, nd0, nd)
     scored = 0
+    goals_skipped = 0
 
     def k_of(spec: GoalSpec, ns_k: Optional[int] = None,
              nd_k: Optional[int] = None) -> int:
@@ -2448,6 +2530,7 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
                     # makes (satisfied + no offline replicas → zero steps,
                     # before == after), minus the fixpoint-program entry.
                     SWEEP_COUNTERS["skipped_goals"] += 1
+                    goals_skipped += 1
                     results.append(GoalResult(
                         name=spec.name, is_hard=spec.is_hard,
                         satisfied_before=True, satisfied_after=True,
@@ -2464,7 +2547,7 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
                     num_sources=ns, num_dests=nd,
                     max_steps=max(max_steps_per_goal, 1),
                     chunk_steps=chunk_len, mesh=mesh, donate=donate,
-                    frontier=use_frontier)
+                    frontier=use_frontier, seed_active=seed_mask)
                 for ch in info["chunks"]:
                     scored += ch["steps"] * k_of(spec, ch["ns"], ch["nd"])
                 if info["actions"]:
@@ -2697,10 +2780,15 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
                                                           balancedness_score)
     costs = balancedness_cost_by_goal(specs, balancedness_priority_weight,
                                       balancedness_strictness_weight)
+    seed_size = int(seed_mask.sum()) if (warm and seed_mask is not None) else 0
+    if warm:
+        _push_warm_sensors(seed_size, goals_skipped)
     return OptimizerRun(model=model, goal_results=results, stats_before=stats_before,
                         stats_after=compute_stats_jit(model), num_candidates_scored=scored,
                         provision_response=provision,
                         balancedness_before=balancedness_score(
                             costs, [g.name for g in results if not g.satisfied_before]),
                         balancedness_after=balancedness_score(
-                            costs, [g.name for g in results if not g.satisfied_after]))
+                            costs, [g.name for g in results if not g.satisfied_after]),
+                        warm=warm, seed_frontier_size=seed_size,
+                        goals_skipped=goals_skipped)

@@ -10,9 +10,11 @@ and trivially correct.
 
 from __future__ import annotations
 
-from typing import Optional
+import dataclasses
+from typing import Dict, Optional
 
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 from jax import Array
 
@@ -170,7 +172,6 @@ class OptimizationOptions:
         # Host (numpy) leaves: on a tunneled TPU each eager jnp.zeros is one
         # runtime RPC; jit arguments are shipped in a single batched
         # transfer instead.
-        import numpy as np
         B = model.num_brokers
         return cls(
             topic_excluded=np.zeros((model.num_topics,), bool),
@@ -179,3 +180,109 @@ class OptimizationOptions:
             requested_dest_only=np.zeros((B,), bool),
             only_move_immigrants=np.zeros((), bool),
         )
+
+
+# ---------------------------------------------------------------------------
+# Warm-start seeding (cruise mode)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ModelDelta:
+    """Host-side diff of a previous converged model against a fresh one.
+
+    ``changed_mask`` flags every broker whose aggregate load moved by more
+    than a relative epsilon OR whose replica set differs between the fresh
+    (actual) placement and the previous converged (target) placement — the
+    second clause is the "previously-active" component of the warm-start
+    seed frontier: brokers the standing target still wants moves on.
+    ``magnitude`` is the relative L1 load delta over the whole cluster, the
+    number the warm/cold threshold compares against."""
+
+    changed_mask: np.ndarray  # bool[B]
+    magnitude: float
+    num_changed: int
+
+    @property
+    def is_zero(self) -> bool:
+        return self.num_changed == 0
+
+
+@dataclasses.dataclass(frozen=True)
+class WarmStart:
+    """Seed for a delta-seeded warm-start optimization.
+
+    ``prev_model`` is the previous CONVERGED model (an ``OptimizerRun``'s
+    ``model``); the fixpoint starts from its placement re-based onto the
+    fresh model's load state.  ``active_mask`` (bool[B], host numpy)
+    restricts the initial frontier to changed ∪ previously-active brokers;
+    the dense confirm chunk still validates convergence, so an undersized
+    mask costs steps, never correctness.  ``per_goal_satisfied`` carries the
+    previous run's per-goal verdicts for observability — the fused
+    already-satisfied sweep remains the authority on skipping."""
+
+    prev_model: TensorClusterModel
+    active_mask: Optional[np.ndarray] = None
+    per_goal_satisfied: Optional[Dict[str, bool]] = None
+
+    def compatible_with(self, model: TensorClusterModel) -> bool:
+        """Seeding is only sound when the replica axis is identical: same
+        padded shapes and the same replica→partition/topic identity (moves
+        change ``replica_broker``, never membership)."""
+        p = self.prev_model
+        if (p.num_brokers != model.num_brokers
+                or p.num_replicas_padded != model.num_replicas_padded
+                or p.num_partitions != model.num_partitions
+                or p.max_rf != model.max_rf):
+            return False
+        return bool(
+            np.array_equal(np.asarray(p.replica_partition),
+                           np.asarray(model.replica_partition))
+            and np.array_equal(np.asarray(p.replica_valid),
+                               np.asarray(model.replica_valid)))
+
+
+def model_delta(prev_model: TensorClusterModel,
+                fresh_model: TensorClusterModel,
+                rel_epsilon: float = 1e-3) -> Optional[ModelDelta]:
+    """Host-side model-delta probe: diff the previous converged model against
+    the fresh one into a changed-broker mask + relative delta magnitude.
+
+    Returns None when the models are shape- or membership-incompatible
+    (brokers added/removed, partitions created, padding changed) — the
+    caller must fall back to a cold solve.  Pure numpy over host fetches of
+    a handful of per-broker aggregates; no compiled program is involved, so
+    the probe itself costs zero device dispatches beyond the two aggregate
+    reads."""
+    ws = WarmStart(prev_model=prev_model)
+    if not ws.compatible_with(fresh_model):
+        return None
+    prev_load = np.asarray(prev_model.broker_load(), dtype=np.float64)
+    new_load = np.asarray(fresh_model.broker_load(), dtype=np.float64)
+    diff = np.abs(new_load - prev_load).sum(axis=1)
+    total = max(float(np.abs(prev_load).sum()), 1e-9)
+    load_changed = diff > rel_epsilon * max(total / max(prev_load.shape[0], 1),
+                                            1e-9)
+    magnitude = float(diff.sum() / total)
+    # Placement component: brokers whose replica set differs between the
+    # fresh actual placement and the previous converged target.
+    prev_rb = np.asarray(prev_model.replica_broker)
+    new_rb = np.asarray(fresh_model.replica_broker)
+    valid = np.asarray(fresh_model.replica_valid)
+    moved = (prev_rb != new_rb) & valid
+    B = fresh_model.num_brokers
+    placement_changed = np.zeros(B, bool)
+    if moved.any():
+        placement_changed[np.unique(prev_rb[moved])] = True
+        placement_changed[np.unique(new_rb[moved])] = True
+    lead_moved = (np.asarray(prev_model.replica_is_leader)
+                  != np.asarray(fresh_model.replica_is_leader)) & valid
+    if lead_moved.any():
+        placement_changed[np.unique(new_rb[lead_moved])] = True
+    # Dead/offline brokers always join the mask — healing moves must see
+    # them even when their loads look unchanged.
+    state_changed = (np.asarray(prev_model.broker_state)
+                     != np.asarray(fresh_model.broker_state))
+    changed = (load_changed | placement_changed | state_changed) \
+        & np.asarray(fresh_model.broker_valid)
+    return ModelDelta(changed_mask=changed, magnitude=magnitude,
+                      num_changed=int(changed.sum()))

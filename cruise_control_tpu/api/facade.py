@@ -25,6 +25,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -38,7 +39,8 @@ from cruise_control_tpu.analyzer.goals.specs import (DEFAULT_GOAL_ORDER,
                                                      DEFAULT_HARD_GOALS,
                                                      GOAL_SPECS,
                                                      INTRA_BROKER_GOAL_ORDER)
-from cruise_control_tpu.analyzer.state import OptimizationOptions
+from cruise_control_tpu.analyzer.state import (OptimizationOptions, WarmStart,
+                                               model_delta)
 from cruise_control_tpu.analyzer.verifier import VerificationError, verify_run
 from cruise_control_tpu.executor.admin import ClusterAdmin, ReassignmentRequest
 from cruise_control_tpu.executor.executor import Executor, OngoingExecutionError
@@ -129,7 +131,9 @@ class CruiseControl:
                  allow_capacity_estimation: bool = True,
                  excluded_topics_pattern: Optional[str] = None,
                  self_healing_exclude_recently_demoted: bool = True,
-                 self_healing_exclude_recently_removed: bool = True):
+                 self_healing_exclude_recently_removed: bool = True,
+                 warm_start_enabled: bool = False,
+                 warm_start_delta_threshold: float = 0.05):
         self.load_monitor = load_monitor
         self.executor = executor
         self.admin = admin
@@ -154,8 +158,21 @@ class CruiseControl:
         self._max_candidates_per_step = max_candidates_per_step
         self._balancedness_weights = (balancedness_priority_weight,
                                       balancedness_strictness_weight)
+        # analyzer.warm.start.*: per-request warm seeding policy.  Off by
+        # default for direct requests (warm=None resolves to this flag);
+        # the cruise loop passes warm=True explicitly, so cruise refreshes
+        # are warm even when requests stay cold.
+        self._warm_start_enabled = warm_start_enabled
+        self._warm_delta_threshold = warm_start_delta_threshold
         self._cache_lock = threading.Lock()
-        self._cached: Optional[Tuple[Tuple[int, int], float, opt.OptimizerRun,
+        # The STANDING PROPOSAL: (model_generation, monotonic time,
+        # pre-optimization model, converged run, renumbered proposals).
+        # The pre-model is the delta-probe baseline (the converged
+        # run.model differs from it by exactly the proposed moves, so
+        # diffing fresh-vs-pre answers "did the cluster move under us"),
+        # and the run.model is the warm seed.
+        self._cached: Optional[Tuple[Tuple[int, int], float,
+                                     TensorClusterModel, opt.OptimizerRun,
                                      List[props.ExecutionProposal]]] = None
 
     # ------------------------------------------------------------------
@@ -253,7 +270,8 @@ class CruiseControl:
     def _optimize(self, model: TensorClusterModel, goals: Optional[Sequence[str]],
                   options: Optional[OptimizationOptions] = None,
                   fast_mode: bool = False,
-                  naming: Optional[Dict[str, object]] = None) -> opt.OptimizerRun:
+                  naming: Optional[Dict[str, object]] = None,
+                  warm_start: Optional[WarmStart] = None) -> opt.OptimizerRun:
         goal_list = list(goals) if goals else self.goals
         if options is None and naming is not None:
             # Config-excluded topics apply to EVERY goal-based operation,
@@ -278,7 +296,7 @@ class CruiseControl:
                                 max_candidates_per_step=self._max_candidates_per_step,
                                 balancedness_priority_weight=self._balancedness_weights[0],
                                 balancedness_strictness_weight=self._balancedness_weights[1],
-                                donate_model=True)
+                                donate_model=True, warm_start=warm_start)
 
     def _finish(self, model: TensorClusterModel, run: opt.OptimizerRun,
                 dryrun: bool, reason: str, naming: Dict[str, object],
@@ -343,48 +361,177 @@ class CruiseControl:
             balancedness_after=run.balancedness_after)
 
     # ------------------------------------------------------------------
+    # Standing proposal (cruise mode / warm start)
+    # ------------------------------------------------------------------
+    def _warm_allowed(self, warm: Optional[bool]) -> bool:
+        """Resolve the tri-state per-request ``warm`` parameter: None
+        defers to analyzer.warm.start.enabled; the cruise loop passes
+        True explicitly (warm is default-on only for cruise)."""
+        return self._warm_start_enabled if warm is None else bool(warm)
+
+    def _confirm_standing(self, crun: opt.OptimizerRun) -> bool:
+        """ONE fused on-device satisfaction sweep over the standing
+        converged placement: every goal the standing run left satisfied
+        must still pass, and no replica may have gone offline.  This is
+        the entire device cost of a zero-delta request — no fixpoint
+        program is dispatched and no frontier-driver fetch happens."""
+        specs = opt.goals_by_priority([g.name for g in crun.goal_results])
+        sweep_fn = opt._get_sweep_fn(tuple(specs), self.constraint)
+        opt.SWEEP_COUNTERS["dispatches"] += 1
+        sat_np, off_np = jax.device_get(sweep_fn(crun.model))
+        if bool(off_np):
+            return False
+        sat = {s.name: bool(v) for s, v in zip(specs, np.asarray(sat_np))}
+        return all(sat.get(g.name, False)
+                   for g in crun.goal_results if g.satisfied_after)
+
+    def _consult_standing(self, model: TensorClusterModel,
+                          warm: Optional[bool], ignore_proposal_cache: bool,
+                          op: str):
+        """Decide how a default-stack request uses the standing proposal.
+
+        Returns ``("hit", standing_entry)`` when the fresh model is
+        delta-free against the standing baseline and the confirm sweep
+        passes (serve the cached proposals outright), ``("warm",
+        WarmStart)`` when the delta is small enough to seed a warm solve,
+        and ``("cold", None)`` otherwise (warm disabled, no standing entry,
+        incompatible membership, or delta above the threshold)."""
+        labels = {"op": op}
+        hits = SENSORS.counter(
+            "CruiseControl.standing-hits", labels=labels,
+            help="Requests answered from the standing proposal after a "
+                 "zero-delta confirm sweep")
+        warms = SENSORS.counter(
+            "CruiseControl.warm-solves", labels=labels,
+            help="Requests solved warm — seeded from the standing "
+                 "converged placement")
+        SENSORS.counter(
+            "CruiseControl.warm-fallbacks", labels=labels,
+            help="Warm solves that failed verification and fell back to a "
+                 "cold solve")
+        if not self._warm_allowed(warm):
+            return "cold", None
+        with self._cache_lock:
+            standing = self._cached
+        if standing is None:
+            return "cold", None
+        _cgen, ctime, pre_model, crun, _cprops = standing
+        delta = model_delta(pre_model, model)
+        if delta is None:
+            return "cold", None  # membership/shape drift: warm unsound
+        fresh = (time.monotonic() - ctime) * 1000 < self._proposal_expiration_ms
+        if delta.is_zero and fresh and not ignore_proposal_cache:
+            if self._confirm_standing(crun):
+                hits.inc(1)
+                return "hit", standing
+            return "cold", None
+        if delta.magnitude <= self._warm_delta_threshold:
+            # Seed frontier = brokers the cluster changed under us ∪
+            # brokers the standing proposal itself touches (its moves are
+            # not applied yet, so they stay live optimization surface).
+            active = delta.changed_mask.copy()
+            touched = model_delta(pre_model, crun.model)
+            if touched is not None:
+                active |= touched.changed_mask
+            warms.inc(1)
+            return "warm", WarmStart(prev_model=crun.model,
+                                     active_mask=active)
+        return "cold", None
+
+    @staticmethod
+    def _standing_result(crun: opt.OptimizerRun,
+                         cprops: List[props.ExecutionProposal],
+                         reason: str) -> OperationResult:
+        """OperationResult view of a cached/standing run (always a
+        verified-ok run — only those are stored)."""
+        return OperationResult(
+            ok=True, dryrun=True, proposals=cprops,
+            violated_goals_before=crun.violated_goals_before,
+            violated_goals_after=crun.violated_goals_after,
+            provision_status=crun.provision_response.status.value,
+            stats_before=crun.stats_before.to_dict(),
+            stats_after=crun.stats_after.to_dict(),
+            reason=reason,
+            capped_goals=[g.name for g in crun.goal_results if g.capped],
+            balancedness_before=crun.balancedness_before,
+            balancedness_after=crun.balancedness_after)
+
+    def refresh_standing_proposals(self, force: bool = False,
+                                   warm: Optional[bool] = None
+                                   ) -> OperationResult:
+        """The cruise loop's tick: bring the standing proposal up to the
+        current model generation.  With ``force=False`` an unchanged
+        generation is a pure cache read; an advanced generation runs the
+        delta probe → zero-delta confirm / warm solve / cold solve.
+        ``force=True`` recomputes even on an unchanged generation
+        (ignore-cache semantics — which also repopulate the cache)."""
+        return self.proposals(ignore_proposal_cache=force, warm=warm)
+
+    # ------------------------------------------------------------------
     # Proposals (cached)
     # ------------------------------------------------------------------
     @_traced_op
     def proposals(self, goals: Optional[Sequence[str]] = None,
                   ignore_proposal_cache: bool = False,
-                  excluded_topics_pattern: Optional[str] = None
-                  ) -> OperationResult:
+                  excluded_topics_pattern: Optional[str] = None,
+                  warm: Optional[bool] = None) -> OperationResult:
         """GET /proposals — cached while the model generation is unchanged
-        and the cache is younger than proposal.expiration.ms."""
+        and the cache is younger than proposal.expiration.ms.
+
+        When warm start applies (config default or ``warm=True``), a
+        generation bump first runs the host-side delta probe against the
+        standing proposal: a zero-delta model serves the standing
+        proposals after one confirm sweep (no fixpoint dispatch), a small
+        delta seeds a warm solve from the standing converged placement,
+        and a large delta (or a warm solve failing verification) falls
+        back to the cold path."""
         gen = self.load_monitor.model_generation().as_tuple()
-        use_cache = (not ignore_proposal_cache and not goals
-                     and not excluded_topics_pattern)
+        default_stack = not goals and not excluded_topics_pattern
+        use_cache = not ignore_proposal_cache and default_stack
         if use_cache:
             with self._cache_lock:
                 if self._cached is not None:
-                    cgen, ctime, crun, cprops = self._cached
+                    cgen, ctime, _cmodel, crun, cprops = self._cached
                     fresh = (time.monotonic() - ctime) * 1000 < self._proposal_expiration_ms
                     if cgen == gen and fresh:
-                        return OperationResult(
-                            ok=True, dryrun=True, proposals=cprops,
-                            violated_goals_before=crun.violated_goals_before,
-                            violated_goals_after=crun.violated_goals_after,
-                            provision_status=crun.provision_response.status.value,
-                            stats_before=crun.stats_before.to_dict(),
-                            stats_after=crun.stats_after.to_dict(),
-                            reason="cached",
-                            capped_goals=[g.name for g in crun.goal_results
-                                          if g.capped],
-                            balancedness_before=crun.balancedness_before,
-                            balancedness_after=crun.balancedness_after)
+                        return self._standing_result(crun, cprops, "cached")
         model, naming = self._model_naming()
         if goals:
             self._validate_goals(goals)
         options = self._base_options(model, naming, excluded_topics_pattern)
-        run = self._optimize(model, goals, options)
+        warm_start = None
+        if default_stack:
+            mode, payload = self._consult_standing(
+                model, warm, ignore_proposal_cache, "proposals")
+            if mode == "hit":
+                _cgen, ctime, pre_model, crun, cprops = payload
+                with self._cache_lock:
+                    # Re-key the standing entry to the advanced generation
+                    # so the next request takes the pure gen fast path.
+                    self._cached = (gen, ctime, pre_model, crun, cprops)
+                return self._standing_result(crun, cprops, "standing")
+            if mode == "warm":
+                warm_start = payload
+        run = self._optimize(model, goals, options, warm_start=warm_start)
         result = self._finish(model, run, dryrun=True, reason="proposals",
                               naming=naming)
+        if warm_start is not None and not result.ok:
+            # Warm solve failed verification: cold fallback (correctness
+            # never rests on the seed).
+            SENSORS.counter(
+                "CruiseControl.warm-fallbacks", labels={"op": "proposals"},
+                help="Warm solves that failed verification and fell back "
+                     "to a cold solve").inc(1)
+            run = self._optimize(model, goals, options)
+            result = self._finish(model, run, dryrun=True,
+                                  reason="proposals", naming=naming)
         # Only verified-good runs are cacheable: a cached entry is always
-        # served with ok=True.
-        if use_cache and result.ok:
+        # served with ok=True.  ignore_proposal_cache recomputes AND
+        # repopulates (reference semantics) — only the read is skipped.
+        if default_stack and result.ok:
             with self._cache_lock:
-                self._cached = (gen, time.monotonic(), run, result.proposals)
+                self._cached = (gen, time.monotonic(), model, run,
+                                result.proposals)
         return result
 
     def invalidate_proposal_cache(self) -> None:
@@ -404,7 +551,8 @@ class CruiseControl:
                   self_healing: bool = False,
                   excluded_topics_pattern: Optional[str] = None,
                   replica_movement_strategies: Optional[Sequence[str]] = None,
-                  replication_throttle: Optional[int] = None) -> OperationResult:
+                  replication_throttle: Optional[int] = None,
+                  warm: Optional[bool] = None) -> OperationResult:
         model, naming = self._model_naming()
         if goals and not self_healing:
             # Self-healing fixes run detection goals, which an operator may
@@ -427,10 +575,54 @@ class CruiseControl:
             # rebalance_disk=true runs the intra-broker (JBOD) stack
             # (intra.broker.goals) instead of the inter-broker default.
             goals = self.intra_broker_goals
-        run = self._optimize(model, goals, options, fast_mode=fast_mode)
-        return self._finish(model, run, dryrun, reason, naming,
-                            strategy=strategy,
-                            replication_throttle=replication_throttle)
+        # Standing-proposal consult applies only to the default stack with
+        # no per-request model/constraint tweaks — anything else must solve
+        # against its own options.
+        default_stack = (not goals and not destination_broker_ids
+                         and not excluded_topics and not rebalance_disk
+                         and not self_healing and not excluded_topics_pattern
+                         and not fast_mode)
+        warm_start = None
+        if default_stack:
+            mode, payload = self._consult_standing(model, warm, False,
+                                                   "rebalance")
+            if mode == "hit":
+                _cgen, _ctime, pre_model, crun, cprops = payload
+                result = self._standing_result(crun, cprops, reason)
+                result.dryrun = dryrun
+                if not dryrun and cprops:
+                    scorer = opt.PlacementScorer.for_run(
+                        pre_model, crun, self.constraint,
+                        *self._balancedness_weights)
+                    execution = self.executor.execute_proposals(
+                        cprops, naming["partitions"],
+                        concurrency_adjust_metrics=self.load_monitor.broker_health_metrics,
+                        strategy=strategy,
+                        replication_throttle=replication_throttle,
+                        balancedness_scorer=scorer)
+                    result.execution = execution
+                    result.ok = execution.ok
+                return result
+            if mode == "warm":
+                warm_start = payload
+        run = self._optimize(model, goals, options, fast_mode=fast_mode,
+                             warm_start=warm_start)
+        result = self._finish(model, run, dryrun, reason, naming,
+                              strategy=strategy,
+                              replication_throttle=replication_throttle)
+        if warm_start is not None and not result.ok \
+                and result.execution is None:
+            # Warm solve failed verification (not an execution failure):
+            # cold fallback.
+            SENSORS.counter(
+                "CruiseControl.warm-fallbacks", labels={"op": "rebalance"},
+                help="Warm solves that failed verification and fell back "
+                     "to a cold solve").inc(1)
+            run = self._optimize(model, goals, options, fast_mode=fast_mode)
+            result = self._finish(model, run, dryrun, reason, naming,
+                                  strategy=strategy,
+                                  replication_throttle=replication_throttle)
+        return result
 
     @_traced_op
     def add_brokers(self, broker_ids: Sequence[int], dryrun: bool = False,
@@ -611,6 +803,14 @@ class CruiseControl:
                 # from analyzer.flight.recorder config) — operators check
                 # here before expecting /flight data.
                 "flightRecorder": opt._flight_recorder(),
+                # Warm-start / standing-proposal policy and the generation
+                # the standing entry was computed at (None = no standing).
+                "warmStart": {
+                    "enabled": self._warm_start_enabled,
+                    "deltaThreshold": self._warm_delta_threshold,
+                    "standingGeneration": (list(self._cached[0])
+                                           if self._cached else None),
+                },
             },
         }
         if detector_manager is not None:

@@ -417,12 +417,16 @@ class CruiseControlApi:
         ignore_cache = _parse_bool(q, "ignore_proposal_cache", False)
         goals = _parse_goals(q)
         excluded = _parse_excluded_topics(q)
+        # Tri-state: absent defers to analyzer.warm.start.enabled,
+        # warm=true/false overrides per request.
+        warm = None if "warm" not in q else _parse_bool(q, "warm", True)
 
         def fn(progress):
             progress.add_step("GeneratingClusterModel")
             progress.add_step("OptimizationProposalGeneration")
             return self.cc.proposals(goals=goals, ignore_proposal_cache=ignore_cache,
-                                     excluded_topics_pattern=excluded)
+                                     excluded_topics_pattern=excluded,
+                                     warm=warm)
         return self._async("proposals", q, fn)
 
     def _ep_user_tasks(self, q):
@@ -480,6 +484,7 @@ class CruiseControlApi:
         excluded = _parse_excluded_topics(q)
         strategies = _parse_strategies(q)
         throttle = _parse_throttle(q)
+        warm = None if "warm" not in q else _parse_bool(q, "warm", True)
 
         def fn(progress):
             progress.add_step("GeneratingClusterModel")
@@ -490,7 +495,8 @@ class CruiseControlApi:
                                      rebalance_disk=rebalance_disk,
                                      excluded_topics_pattern=excluded,
                                      replica_movement_strategies=strategies,
-                                     replication_throttle=throttle)
+                                     replication_throttle=throttle,
+                                     warm=warm)
         return self._async("rebalance", q, fn)
 
     def _ep_add_broker(self, q):

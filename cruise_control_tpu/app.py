@@ -250,7 +250,10 @@ class KafkaCruiseControlApp:
             self_healing_exclude_recently_demoted=cfg.get(
                 C.SELF_HEALING_EXCLUDE_RECENTLY_DEMOTED_BROKERS_CONFIG),
             self_healing_exclude_recently_removed=cfg.get(
-                C.SELF_HEALING_EXCLUDE_RECENTLY_REMOVED_BROKERS_CONFIG))
+                C.SELF_HEALING_EXCLUDE_RECENTLY_REMOVED_BROKERS_CONFIG),
+            warm_start_enabled=cfg.get(C.WARM_START_ENABLED_CONFIG),
+            warm_start_delta_threshold=cfg.get(
+                C.WARM_START_DELTA_THRESHOLD_CONFIG))
 
         provisioner = cfg.get_configured_instance(
             C.PROVISIONER_CLASS_CONFIG, Provisioner)
@@ -382,6 +385,31 @@ class KafkaCruiseControlApp:
                         precompute_flight.release()
                 self._stop.wait(wait_s)
 
+        # Cruise loop (analyzer.cruise.*): keep ONE standing proposal per
+        # cluster model.  Unlike the precompute loop (fixed cadence, cold
+        # solves), cruise watches the model generation and refreshes the
+        # standing proposal WARM whenever it advances: zero-delta ticks cost
+        # one confirm sweep, small deltas a seeded solve.  Shares the
+        # precompute single-flight lock so concurrent refreshes never race
+        # on the same model build.
+        def cruise_loop():
+            wait_s = cfg.get(C.CRUISE_INTERVAL_MS_CONFIG) / 1000.0
+            last_gen = None
+            while not self._stop.is_set():
+                if self.load_monitor.generation_changed(last_gen) \
+                        and precompute_flight.acquire(blocking=False):
+                    try:
+                        gen = self.load_monitor.model_generation().as_tuple()
+                        result = self.cruise_control.refresh_standing_proposals(
+                            warm=True)
+                        if result.ok:
+                            last_gen = gen
+                    except Exception:  # noqa: BLE001 — not enough windows yet
+                        pass
+                    finally:
+                        precompute_flight.release()
+                self._stop.wait(wait_s)
+
         # Sensor/state updater (LoadMonitor.java:177-179 sensor updater
         # thread): refreshes the monitored-percentage cache at
         # monitor.state.update.interval.ms so /metrics gauges stay fresh
@@ -400,6 +428,8 @@ class KafkaCruiseControlApp:
                  ("cc-monitor-state-updater", state_updater_loop)]
         loops += [(f"cc-proposal-precompute-{i}", precompute_loop)
                   for i in range(cfg.get(C.NUM_PROPOSAL_PRECOMPUTE_THREADS_CONFIG))]
+        if cfg.get(C.CRUISE_ENABLED_CONFIG):
+            loops.append(("cc-cruise", cruise_loop))
         for name, fn in loops:
             t = threading.Thread(target=fn, daemon=True, name=name)
             t.start()

@@ -51,6 +51,10 @@ COMPILE_CACHE_DIR_CONFIG = "compile.cache.dir"
 COMPILE_CACHE_WARMUP_CONFIG = "compile.cache.warmup"
 TPU_COMPILE_CEILING_CONFIG = "tpu.compile.ceiling"
 ANALYZER_FLIGHT_RECORDER_CONFIG = "analyzer.flight.recorder"
+WARM_START_ENABLED_CONFIG = "analyzer.warm.start.enabled"
+WARM_START_DELTA_THRESHOLD_CONFIG = "analyzer.warm.start.delta.threshold"
+CRUISE_ENABLED_CONFIG = "analyzer.cruise.enabled"
+CRUISE_INTERVAL_MS_CONFIG = "analyzer.cruise.interval.ms"
 
 DEFAULT_GOAL_NAMES = [
     "RackAwareGoal",
@@ -186,6 +190,31 @@ def analyzer_config_def() -> ConfigDef:
                  "Surfaced via GET /flight, analyzer.goal trace spans, and the "
                  "GoalOptimizer.actions-per-step / steps-to-90pct-actions "
                  "sensors.", group="analyzer")
+    d.define(WARM_START_ENABLED_CONFIG, Type.BOOLEAN, False, importance=Importance.MEDIUM,
+             doc="Seed request-path solves from the standing proposal when the "
+                 "host-side model-delta probe reports a small enough change: a "
+                 "zero-delta request serves the standing proposals after one "
+                 "on-device confirm sweep (no fixpoint dispatch), a small delta "
+                 "warm-starts the fixpoint from the previously-converged "
+                 "placement.  Off: requests solve cold, bit-identical to the "
+                 "pre-warm-start behavior.  The cruise loop always refreshes "
+                 "warm regardless of this flag.", group="analyzer")
+    d.define(WARM_START_DELTA_THRESHOLD_CONFIG, Type.DOUBLE, 0.05, Range.between(0.0, 1.0),
+             Importance.LOW,
+             doc="Max relative load delta (changed-load / total-load) for which a "
+                 "warm-started solve is attempted; larger deltas solve cold.",
+             group="analyzer")
+    d.define(CRUISE_ENABLED_CONFIG, Type.BOOLEAN, False, importance=Importance.MEDIUM,
+             doc="Run the cruise loop: a background thread that keeps ONE standing "
+                 "proposal per cluster model, re-optimizing (warm-started) whenever "
+                 "the load monitor's model generation advances, so /proposals and "
+                 "/rebalance answer from the standing result instead of solving "
+                 "from zero.", group="analyzer")
+    d.define(CRUISE_INTERVAL_MS_CONFIG, Type.LONG, 30_000, Range.at_least(100),
+             Importance.LOW,
+             doc="Cruise loop poll interval: how often the loop checks whether the "
+                 "model generation advanced past the standing proposal.",
+             group="analyzer")
     return d
 
 

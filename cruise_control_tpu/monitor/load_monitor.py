@@ -193,6 +193,13 @@ class LoadMonitor:
         return ModelGeneration(self._metadata.cluster().generation,
                                self.partition_aggregator.generation)
 
+    def generation_changed(self, since) -> bool:
+        """Has the model generation advanced past ``since`` (an
+        ``as_tuple()`` value; None = no baseline → always True)?  The
+        cruise loop's cheap poll predicate — no model build, just two
+        counter reads."""
+        return since is None or self.model_generation().as_tuple() != tuple(since)
+
     # -- sampling ----------------------------------------------------------
     def fetch_once(self, sampler: MetricSampler, start_ms: int, end_ms: int,
                    mode: SamplingMode = SamplingMode.ALL) -> int:

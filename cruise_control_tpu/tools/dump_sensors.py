@@ -72,11 +72,17 @@ def build_stack():
         lm.fetch_once(sampler, wdx * window_ms, wdx * window_ms + 1)
     admin = InMemoryClusterAdmin(mc, latency_polls=1)
     ex = Executor(admin, mc)
+    # Warm start enabled with a permissive delta threshold so the exercise
+    # below deterministically drives BOTH standing-proposal outcomes (a
+    # zero-delta standing hit and a delta-seeded warm solve) and their
+    # sensor families register.
     cc = CruiseControl(lm, ex, admin,
                        goals=["RackAwareGoal", "DiskCapacityGoal",
                               "ReplicaDistributionGoal",
                               "LeaderReplicaDistributionGoal"],
-                       hard_goals=["RackAwareGoal", "DiskCapacityGoal"])
+                       hard_goals=["RackAwareGoal", "DiskCapacityGoal"],
+                       warm_start_enabled=True,
+                       warm_start_delta_threshold=1.0)
     mgr = AnomalyDetectorManager(SelfHealingNotifier(), cc,
                                  executor_busy=lambda: ex.has_ongoing_execution)
     from cruise_control_tpu.detector.detectors import BrokerFailureDetector
@@ -117,6 +123,28 @@ def exercise(api, mgr) -> None:
             os.environ.pop("CRUISE_FLIGHT_RECORDER", None)
         else:
             os.environ["CRUISE_FLIGHT_RECORDER"] = saved
+    # Standing-proposal / warm-start families.  The first proposals call
+    # stores the standing entry; a metadata refresh with identical content
+    # bumps the model generation without a load delta, so the next call is
+    # a zero-delta standing hit (CruiseControl.standing-hits); one more
+    # sampler window (from a sampler with a nudged mean — the stock one is
+    # hash-stable, so a new window would be a zero delta) then perturbs the
+    # loads and — with the stack's permissive delta threshold — the final
+    # call runs a delta-seeded warm solve, registering the
+    # GoalOptimizer.warm-start-* families.
+    from cruise_control_tpu.monitor.sampling import SyntheticWorkloadSampler
+    cc = api.cc
+    lm = cc.load_monitor
+    cc.proposals()
+    lm._metadata.refresh(lm._metadata.cluster())
+    cc.proposals()
+    window_ms = lm.partition_aggregator.window_ms
+    nudged = SyntheticWorkloadSampler(mean_nw_kb=108.0)
+    # Two windows: the in-progress window is excluded from aggregation, so
+    # the first nudged window only becomes visible once the second starts.
+    for wdx in (4, 5):
+        lm.fetch_once(nudged, wdx * window_ms, wdx * window_ms + 1)
+    cc.proposals(warm=True)
     # Small simulated execution (virtual fleet, synthetic health feed):
     # registers the execution-ledger families — Executor.* progress gauges,
     # adjuster-decision counters (both directions), per-type task-duration

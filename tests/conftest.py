@@ -51,6 +51,31 @@ def _reset_observability_between_modules():
 
 
 @pytest.fixture(autouse=True, scope="module")
+def _no_stray_nondaemon_threads():
+    """Every service loop (state updater, cruise loop, detector ticker,
+    executor phases) must either run as a daemon or be joined by its
+    owner's stop() — a module that leaks a live non-daemon thread would
+    hang the pytest process at interpreter exit."""
+    import threading
+    import time
+    yield
+
+    def stray():
+        return [t for t in threading.enumerate()
+                if t.is_alive() and not t.daemon
+                and t is not threading.main_thread()]
+    # Grace-drain: graceful shutdowns may leave a self-terminating thread
+    # (e.g. grpc's cancel_all_calls_after_grace lives for stop(grace=N)).
+    deadline = time.monotonic() + 3.0
+    while stray() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    left = stray()
+    assert not left, (
+        f"test module leaked non-daemon threads: "
+        f"{[t.name for t in left]} — join them in the owning stop()")
+
+
+@pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():
     """Free compiled executables between test modules.
 

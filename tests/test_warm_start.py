@@ -1,0 +1,257 @@
+"""Warm-start / standing-proposal tests (cruise mode).
+
+Pins the PR's acceptance bars at both layers:
+
+- facade: a zero-delta request is answered from the standing proposal with
+  ONE fused confirm sweep and zero fixpoint dispatches (device-fetch
+  counters frozen); ``ignore_proposal_cache=True`` recomputes AND
+  repopulates the standing cache; warm disabled takes the plain cold path
+  untouched by the standing machinery;
+- optimizer: a warm solve on a small per-partition perturbation is
+  verifier-clean and equisatisfying against its cold twin (the PR 4
+  oracle-differential pattern); passing ``warm_start=None`` — and passing
+  an *incompatible* warm start — is bit-identical to the cold solve.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from cruise_control_tpu.analyzer import optimizer as opt  # noqa: E402
+from cruise_control_tpu.analyzer import proposals as props  # noqa: E402
+from cruise_control_tpu.analyzer.state import (  # noqa: E402
+    WarmStart,
+    model_delta,
+)
+from cruise_control_tpu.analyzer.verifier import verify_run  # noqa: E402
+from cruise_control_tpu.api.facade import CruiseControl  # noqa: E402
+from cruise_control_tpu.executor.admin import InMemoryClusterAdmin  # noqa: E402
+from cruise_control_tpu.executor.executor import Executor  # noqa: E402
+from cruise_control_tpu.model.generator import (  # noqa: E402
+    ClusterSpec,
+    generate_cluster,
+)
+from cruise_control_tpu.monitor.capacity import StaticCapacityResolver  # noqa: E402
+from cruise_control_tpu.monitor.load_monitor import LoadMonitor  # noqa: E402
+from cruise_control_tpu.monitor.metadata import (  # noqa: E402
+    BrokerInfo,
+    ClusterMetadata,
+    MetadataClient,
+    PartitionInfo,
+)
+from cruise_control_tpu.monitor.sampling import SyntheticWorkloadSampler  # noqa: E402
+
+W = 300_000
+
+STACK = [
+    "RackAwareGoal", "ReplicaCapacityGoal", "DiskCapacityGoal",
+    "NetworkInboundCapacityGoal", "NetworkOutboundCapacityGoal",
+    "CpuCapacityGoal", "ReplicaDistributionGoal",
+    "LeaderReplicaDistributionGoal",
+]
+
+
+def build_cc(warm_enabled=True, threshold=1.0, num_brokers=5):
+    """tests/test_api.py::build_stack, reduced to the facade and with the
+    warm-start knobs exposed."""
+    rng = np.random.default_rng(19)
+    brokers = tuple(BrokerInfo(b, rack=f"r{b % 3}", host=f"h{b}")
+                    for b in range(num_brokers))
+    w = np.linspace(1, 4, num_brokers)
+    w /= w.sum()
+    parts = []
+    for t in range(3):
+        for p in range(8):
+            reps = tuple(int(x) for x in
+                         rng.choice(num_brokers, 2, replace=False, p=w))
+            parts.append(PartitionInfo(f"t{t}", p, leader=reps[0],
+                                       replicas=reps))
+    mc = MetadataClient(ClusterMetadata(brokers=brokers,
+                                        partitions=tuple(parts)))
+    lm = LoadMonitor(mc, StaticCapacityResolver(), num_partition_windows=3,
+                     partition_window_ms=W)
+    lm.start_up()
+    sampler = SyntheticWorkloadSampler()
+    for wdx in range(4):
+        lm.fetch_once(sampler, wdx * W, wdx * W + 1)
+    admin = InMemoryClusterAdmin(mc, latency_polls=1)
+    cc = CruiseControl(lm, Executor(admin, mc), admin,
+                       goals=["RackAwareGoal", "DiskCapacityGoal",
+                              "ReplicaDistributionGoal",
+                              "LeaderReplicaDistributionGoal"],
+                       hard_goals=["RackAwareGoal", "DiskCapacityGoal"],
+                       warm_start_enabled=warm_enabled,
+                       warm_start_delta_threshold=threshold)
+    return cc, lm
+
+
+def _bump_generation(lm):
+    """Advance the model generation with bit-identical content — the
+    zero-delta case the standing proposal exists for."""
+    lm._metadata.refresh(lm._metadata.cluster())
+
+
+# ---------------------------------------------------------------------------
+# Facade: standing proposal
+# ---------------------------------------------------------------------------
+
+def test_zero_delta_served_without_fixpoint_dispatch():
+    cc, lm = build_cc()
+    r1 = cc.proposals()
+    assert r1.ok and r1.reason == "proposals"
+    assert cc._cached is not None
+    _bump_generation(lm)
+    fetches = dict(opt.FETCH_COUNTERS)
+    r2 = cc.proposals()
+    assert r2.ok and r2.reason == "standing"
+    # The entire device cost of the zero-delta answer is one fused confirm
+    # sweep — no fixpoint program runs, so the frontier/stack drivers'
+    # device-fetch counters must not move at all.
+    assert dict(opt.FETCH_COUNTERS) == fetches
+    assert r2.proposals == r1.proposals
+    # The hit re-keyed the standing entry to the advanced generation, so
+    # the next request takes the pure cache read (no confirm sweep either).
+    sweeps = dict(opt.SWEEP_COUNTERS)
+    r3 = cc.proposals()
+    assert r3.reason == "cached"
+    assert dict(opt.SWEEP_COUNTERS) == sweeps
+    assert dict(opt.FETCH_COUNTERS) == fetches
+
+
+def test_ignore_proposal_cache_recomputes_and_repopulates():
+    cc, lm = build_cc()
+    cc.proposals()
+    gen0, t0 = cc._cached[0], cc._cached[1]
+    r = cc.proposals(ignore_proposal_cache=True)
+    # ignore = recompute AND repopulate: the standing entry must be the
+    # fresh run, not the one the ignored read skipped.
+    assert r.ok and r.reason == "proposals"
+    assert cc._cached[0] == gen0 and cc._cached[1] > t0
+    fetches = dict(opt.FETCH_COUNTERS)
+    assert cc.proposals().reason == "cached"
+    assert dict(opt.FETCH_COUNTERS) == fetches
+    # refresh_standing_proposals(force=True) is the same repopulating path.
+    t1 = cc._cached[1]
+    assert cc.refresh_standing_proposals(force=True).ok
+    assert cc._cached[1] > t1
+
+
+def test_warm_disabled_takes_plain_cold_path():
+    cc, lm = build_cc(warm_enabled=False)
+    r1 = cc.proposals()
+    assert r1.ok and r1.reason == "proposals"
+    _bump_generation(lm)
+    # Warm disabled: a generation bump is a plain cold recompute — never
+    # "standing", and bit-identical proposals to the enabled-stack cold
+    # solve on the identical model (the standing machinery is bypassed
+    # before it can influence anything).
+    r2 = cc.proposals()
+    assert r2.ok and r2.reason == "proposals"
+    cc_on, lm_on = build_cc(warm_enabled=True)
+    r_on = cc_on.proposals()
+    assert r2.proposals == r_on.proposals
+
+
+def test_state_reports_warm_start_block():
+    cc, _ = build_cc(threshold=0.25)
+    st = cc.state()["AnalyzerState"]["warmStart"]
+    assert st["enabled"] is True
+    assert st["deltaThreshold"] == 0.25
+    assert st["standingGeneration"] is None
+    cc.proposals()
+    assert cc.state()["AnalyzerState"]["warmStart"]["standingGeneration"] \
+        is not None
+
+
+# ---------------------------------------------------------------------------
+# Optimizer: delta-seeded warm solve
+# ---------------------------------------------------------------------------
+
+def _gen_model(seed=11, brokers=8):
+    spec = ClusterSpec(num_brokers=brokers, num_racks=4, num_topics=4,
+                       mean_partitions_per_topic=24.0, replication_factor=2,
+                       distribution="exponential", seed=seed)
+    return generate_cluster(spec)
+
+
+def _perturb(model, rng, frac=0.25):
+    """Per-partition traffic tick (same shape as bench.py --warm): load is
+    a partition property, so siblings scale together — anything else would
+    let leadership transfers change cluster totals."""
+    rb = np.asarray(model.replica_broker)
+    rp = np.asarray(model.replica_partition)
+    lead = np.asarray(model.replica_is_leader) & np.asarray(model.replica_valid)
+    k = max(1, int(model.num_brokers * frac))
+    chosen = np.asarray(rng.choice(model.num_brokers, size=k, replace=False))
+    hot = np.zeros(model.num_partitions, dtype=bool)
+    hot[rp[lead & np.isin(rb, chosen)]] = True
+    ll = np.array(model.replica_load_leader)
+    factor = np.ones((model.num_partitions, 1), dtype=ll.dtype)
+    factor[hot] = rng.uniform(0.9, 1.1, size=(int(hot.sum()), 1))
+    lf = np.array(model.replica_load_follower)
+    ll *= factor[rp]
+    lf *= factor[rp]
+    import jax.numpy as jnp
+    return model.replace(replica_load_leader=jnp.asarray(ll),
+                         replica_load_follower=jnp.asarray(lf))
+
+
+def _solve(model, warm_start=None):
+    return opt.optimize(opt.donation_copy(model), STACK,
+                        raise_on_hard_failure=False, fused=True,
+                        fuse_group_size=1, donate_model=True,
+                        warm_start=warm_start)
+
+
+def test_warm_solve_small_perturbation_equisatisfying():
+    base = _gen_model()
+    prev = _solve(base)
+    rng = np.random.default_rng(5)
+    model = _perturb(base, rng)
+    cold = _solve(model)
+    delta = model_delta(prev.model, model)
+    assert delta is not None and not delta.is_zero
+    warm = _solve(model, warm_start=WarmStart(prev_model=prev.model,
+                                              active_mask=delta.changed_mask))
+    assert warm.warm and not cold.warm
+    # Verifier-clean: totals conserved, RF unchanged, hard goals hold.
+    verify_run(model, warm, [g.name for g in warm.goal_results],
+               proposals=props.diff(model, warm.model))
+    cold_sat = {g.name: g.satisfied_after for g in cold.goal_results}
+    warm_sat = {g.name: g.satisfied_after for g in warm.goal_results}
+    assert all(warm_sat[n] for n, ok in cold_sat.items() if ok), \
+        f"warm under-satisfied: cold={cold_sat} warm={warm_sat}"
+    # The seeded solve starts at the previous converged placement, so the
+    # already-clean goals skip via the fused satisfied sweep.
+    assert warm.goals_skipped >= cold.goals_skipped
+
+
+def test_no_warm_start_bit_identical(monkeypatch):
+    """``warm_start=None`` and an incompatible warm start must both be the
+    cold solve, bitwise (the disable-pin of the PR 4 differential
+    pattern)."""
+    model = _gen_model(seed=3)
+    for name in ("_step_cache", "_fixpoint_cache", "_budget_cache",
+                 "_stack_cache"):
+        monkeypatch.setattr(opt, name, {})
+    run_a = _solve(model)
+    for name in ("_step_cache", "_fixpoint_cache", "_budget_cache",
+                 "_stack_cache"):
+        monkeypatch.setattr(opt, name, {})
+    # A warm start whose replica axis does not match the model is unsound
+    # and must be ignored wholesale (compatible_with gate).
+    alien = WarmStart(prev_model=_gen_model(seed=4, brokers=6))
+    run_b = _solve(model, warm_start=alien)
+    assert not run_b.warm
+    np.testing.assert_array_equal(np.asarray(run_a.model.replica_broker),
+                                  np.asarray(run_b.model.replica_broker))
+    np.testing.assert_array_equal(np.asarray(run_a.model.replica_is_leader),
+                                  np.asarray(run_b.model.replica_is_leader))
+    assert [(g.name, g.steps, g.actions_applied)
+            for g in run_a.goal_results] == \
+        [(g.name, g.steps, g.actions_applied) for g in run_b.goal_results]

@@ -134,6 +134,68 @@ def print_curves(rep: dict) -> None:
     print(f"timeline_complete: {rep['timeline_complete']}")
 
 
+def build_overlay(record: dict):
+    """Cold-vs-warm convergence overlay from a record carrying TWO
+    timelines (``cold``/``warm`` blocks with per_goal flight data —
+    bench.py --warm writes WARM_<rung>.json in this shape).  Returns None
+    when the record is not two-sided."""
+    sides = {}
+    for side in ("cold", "warm"):
+        blk = record.get(side)
+        if not isinstance(blk, dict) or "per_goal" not in blk:
+            return None
+        sides[side] = blk["per_goal"]
+    goals = {}
+    for name in sorted(set(sides["cold"]) | set(sides["warm"])):
+        row = {}
+        for side in ("cold", "warm"):
+            g = sides[side].get(name, {})
+            flight = g.get("flight") or {}
+            steps = int(g.get("steps", 0))
+            row[side] = {
+                "steps": steps,
+                "actions": int(g.get("actions", 0)),
+                "wall_s": float(g.get("wall_s", 0.0)),
+                "steps_to_90pct_actions": steps_to_90pct(
+                    flight.get("steps", [])),
+                # A warm-skipped goal ran zero steps and recorded no
+                # timeline: its fused satisfied sweep still passed.
+                "skipped": steps == 0 and not flight,
+            }
+        goals[name] = row
+    return {
+        "metric": "flight_overlay",
+        "source_metric": record.get("metric"),
+        "speedup": record.get("value"),
+        "cold_wall_s": record.get("cold_wall_s",
+                                  record["cold"].get("wall_s")),
+        "warm_wall_s": record.get("warm_wall_s",
+                                  record["warm"].get("wall_s")),
+        "goals_skipped_warm": sum(1 for r in goals.values()
+                                  if r["warm"]["skipped"]),
+        "goals": goals,
+    }
+
+
+def print_overlay(rep: dict) -> None:
+    print(f"cold vs warm ({rep.get('source_metric')}): "
+          f"speedup {rep.get('speedup')}x  "
+          f"wall {rep.get('cold_wall_s')}s -> {rep.get('warm_wall_s')}s  "
+          f"({rep.get('goals_skipped_warm')} goals skipped warm)")
+    hdr = (f"{'goal':<40} {'to90% c/w':>12} {'steps c/w':>12} "
+           f"{'wall_s c/w':>16}")
+    print(hdr)
+    print("-" * len(hdr))
+    for name, row in sorted(rep["goals"].items()):
+        c, w = row["cold"], row["warm"]
+        w90 = "skip" if w["skipped"] else str(w["steps_to_90pct_actions"])
+        ws = "skip" if w["skipped"] else str(w["steps"])
+        to90 = "%d/%s" % (c["steps_to_90pct_actions"], w90)
+        steps = "%d/%s" % (c["steps"], ws)
+        wall = "%.3f/%.3f" % (c["wall_s"], w["wall_s"])
+        print(f"{name:<40} {to90:>12} {steps:>12} {wall:>16}")
+
+
 def run_live(rung: str) -> dict:
     """Run one bench rung with the recorder forced on; returns a bench-shaped
     record whose per_goal blocks carry flight timelines."""
@@ -165,11 +227,28 @@ def run_live(rung: str) -> dict:
     }
 
 
+def _load_record(path: str) -> dict:
+    with open(path) as f:
+        text = f.read().strip()
+    try:
+        # FLIGHT/WARM artifacts are one indented JSON document …
+        record = json.loads(text)
+    except json.JSONDecodeError:
+        # … bench output is .jsonl (one record per line, last wins).
+        record = json.loads(text.splitlines()[-1])
+    if "per_goal" not in record and "goals" not in record \
+            and "cold" not in record and "rungs" in record:
+        record = record["rungs"][-1]
+    return record
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("record", nargs="?",
+    ap.add_argument("record", nargs="*",
                     help="FLIGHT_*.json artifact or bench record with "
-                         "flight blocks")
+                         "flight blocks; a WARM_*.json two-timeline record "
+                         "(or TWO records: cold then warm) renders the "
+                         "cold-vs-warm overlay")
     ap.add_argument("--run", metavar="RUNG",
                     help="run this bench rung live with the recorder on")
     ap.add_argument("-o", "--out",
@@ -179,20 +258,22 @@ def main() -> None:
     args = ap.parse_args()
     if args.run:
         record = run_live(args.run)
+    elif len(args.record) == 2:
+        # Two timelines on the command line: first cold, second warm.
+        record = {"metric": "overlay_cli",
+                  "cold": _load_record(args.record[0]),
+                  "warm": _load_record(args.record[1])}
     elif args.record:
-        with open(args.record) as f:
-            text = f.read().strip()
-        try:
-            # FLIGHT artifacts are one indented JSON document …
-            record = json.loads(text)
-        except json.JSONDecodeError:
-            # … bench output is .jsonl (one record per line, last wins).
-            record = json.loads(text.splitlines()[-1])
-        if "per_goal" not in record and "goals" not in record \
-                and "rungs" in record:
-            record = record["rungs"][-1]
+        record = _load_record(args.record[0])
     else:
         ap.error("need an artifact/bench record path (or --run RUNG)")
+    overlay = build_overlay(record)
+    if overlay is not None:
+        if args.json:
+            print(json.dumps(overlay), flush=True)
+        else:
+            print_overlay(overlay)
+        return
     rep = build_report(record)
     if args.out:
         write_artifact(record, args.out)
