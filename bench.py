@@ -568,6 +568,212 @@ def run_warm_rung(scale: str, max_candidates, fast: bool) -> dict:
     return rec
 
 
+def _compile_ceiling_probe(constraint, options_cls, ceiling: int = 32_768) -> dict:
+    """Probe candidate-width shapes past the 375k→500k single-chip compile
+    wall THROUGH the integer ``CRUISE_TPU_COMPILE_CEILING`` gate: build the
+    xl375/xl500 models, let ``_cross_ceiling_k`` parse the integer ceiling,
+    mirror ``_optimize``'s width clamp, and AOT lower+compile ONE goal's
+    budget-fixpoint program at the clamped shape.  The wall the ceiling was
+    introduced for is a tunneled-TPU remote-compile phenomenon; on any
+    other backend this records that the gated, clamped shape lowers and
+    compiles — the honest CPU-side evidence that the integer knob selects
+    a compilable program (``backend`` says which side produced the record).
+    Budget-guarded: rungs are skipped, not wedged, when the bench's total
+    budget would not survive the compile."""
+    import jax
+    import jax.numpy as jnp
+
+    from cruise_control_tpu.analyzer import candidates as cgen
+    from cruise_control_tpu.analyzer import optimizer as opt
+    from cruise_control_tpu.analyzer.goals.specs import goals_by_priority
+    from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster
+
+    prev_env = os.environ.get("CRUISE_TPU_COMPILE_CEILING")
+    os.environ["CRUISE_TPU_COMPILE_CEILING"] = str(ceiling)
+    try:
+        parsed = opt._cross_ceiling_k()
+    finally:
+        if prev_env is None:
+            os.environ.pop("CRUISE_TPU_COMPILE_CEILING", None)
+        else:
+            os.environ["CRUISE_TPU_COMPILE_CEILING"] = prev_env
+    probe = {"ceiling": ceiling, "parsed": parsed,
+             "backend": jax.default_backend(), "rungs": []}
+    if parsed != ceiling:
+        probe["error"] = "integer ceiling did not parse"
+        return probe
+    gspec = goals_by_priority(["ReplicaDistributionGoal"])[0]
+    for scale in ("xl375", "xl500"):
+        if _budget_remaining() < 150.0:
+            probe["rungs"].append({"scale": scale,
+                                   "skipped": "total_budget_low"})
+            continue
+        brokers, racks, topics, ppt, rf = SCALES[scale]
+        spec = ClusterSpec(num_brokers=brokers, num_racks=racks,
+                           num_topics=topics, mean_partitions_per_topic=ppt,
+                           replication_factor=rf, distribution="exponential",
+                           seed=2026)
+        model = jax.device_put(generate_cluster(spec))
+        jax.block_until_ready(model)
+        ns0 = cgen.default_num_sources(model)
+        nd0 = cgen.default_num_dests(model)
+        ns, nd = ns0, nd0
+        if ns * nd > ceiling:  # the clamp _optimize applies under the gate
+            nd = max(8, ceiling // ns)
+            if ns * nd > ceiling:
+                ns = max(64, ceiling // nd)
+        rung = {"scale": scale,
+                "num_replicas": int(model.replica_valid.sum()),
+                "num_brokers": brokers,
+                "ns": [ns0, ns], "nd": [nd0, nd], "k": ns * nd}
+        fn = opt._get_budget_fixpoint_fn(gspec, (), constraint, ns, nd)
+        t0 = time.monotonic()
+        try:
+            compiled = fn.lower(model, options_cls.none(model),
+                                jnp.int32(8)).compile()
+            rung["compile_s"] = round(time.monotonic() - t0, 1)
+            rung["ok"] = compiled is not None
+        except Exception as e:  # record the failure, don't kill the rung
+            rung["compile_s"] = round(time.monotonic() - t0, 1)
+            rung["ok"] = False
+            rung["error"] = f"{type(e).__name__}: {e}"[:200]
+        probe["rungs"].append(rung)
+        del model
+    return probe
+
+
+def run_pipeline_rung(scale: str, max_candidates, fast: bool) -> dict:
+    """--pipeline: inter-goal pipelining twin rung.  Solve the rung's full
+    15-goal stack twice from the same snapshot — sequential per-goal
+    chunking (``pipeline=False, fuse_group_size=1``) and the pipelined path
+    (``pipeline=True``: up-front fused frontier sweep, auto disjoint-frontier
+    fusion, speculative cross-goal openers) — warm each flavor first so both
+    timed passes run over cached executables.  The pipelined placement must
+    be BIT-IDENTICAL to the sequential one and its proposals verifier-clean
+    and equisatisfying; any miss fails the rung inside its watchdog rather
+    than recording a bad artifact.  Writes PIPELINE_<rung>.json including a
+    compile-ceiling probe past the 375k-replica wall (satellite: the probe
+    rides this artifact because the pipeline exists to attack the same
+    1M-replica wall from the orchestration side)."""
+    brokers, racks, topics, ppt, rf = SCALES[scale]
+
+    import jax
+    import numpy as np
+
+    from cruise_control_tpu.analyzer import optimizer as opt
+    from cruise_control_tpu.analyzer import proposals as props
+    from cruise_control_tpu.analyzer.balancing_constraint import BalancingConstraint
+    from cruise_control_tpu.analyzer.state import OptimizationOptions
+    from cruise_control_tpu.analyzer.verifier import verify_run
+    from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster
+
+    spec = ClusterSpec(num_brokers=brokers, num_racks=racks, num_topics=topics,
+                       mean_partitions_per_topic=ppt, replication_factor=rf,
+                       distribution="exponential", seed=2026)
+    model = jax.device_put(generate_cluster(spec))
+    jax.block_until_ready(model)
+    num_replicas = int(model.replica_valid.sum())
+
+    def solve(pipelined: bool):
+        kw = dict(raise_on_hard_failure=False, fused=True,
+                  max_candidates_per_step=max_candidates, fast_mode=fast,
+                  donate_model=True)
+        if pipelined:
+            # Explicit opt-in: the auto policy only pipelines above the
+            # frontier threshold; the twin rung forces both flavors at
+            # every scale so the comparison exists on the whole ladder.
+            kw["pipeline"] = True
+        else:
+            kw["pipeline"] = False
+            kw["fuse_group_size"] = 1
+        # Warm-up compiles this flavor's programs (sequential and pipelined
+        # drivers trace different chunk signatures — each needs its own).
+        opt.optimize(opt.donation_copy(model), STACK, **kw)
+        disp0 = dict(opt.FETCH_COUNTERS)
+        t0 = time.monotonic()
+        run = opt.optimize(opt.donation_copy(model), STACK, **kw)
+        wall = time.monotonic() - t0
+        fetches = {k: opt.FETCH_COUNTERS[k] - disp0[k] for k in disp0}
+        return run, wall, fetches
+
+    seq_run, seq_wall, seq_f = solve(False)
+    pipe_run, pipe_wall, pipe_f = solve(True)
+
+    # Bit-identity: the conflict gate's whole contract.  np.array_equal on
+    # the three placement arrays — any drift is a correctness bug, not a
+    # perf miss.
+    identical = all(
+        np.array_equal(np.asarray(getattr(seq_run.model, f)),
+                       np.asarray(getattr(pipe_run.model, f)))
+        for f in ("replica_broker", "replica_is_leader", "replica_disk"))
+    if not identical:
+        raise SystemExit(
+            f"pipelined placement diverged from sequential on rung {scale}")
+    seq_sat = {g.name: g.satisfied_after for g in seq_run.goal_results}
+    pipe_sat = {g.name: g.satisfied_after for g in pipe_run.goal_results}
+    equisat = all(pipe_sat[name] for name, ok in seq_sat.items() if ok)
+    if not equisat:
+        raise SystemExit(
+            f"pipelined solve under-satisfied vs sequential on rung {scale}: "
+            f"seq={seq_sat} pipe={pipe_sat}")
+    pipe_props = props.diff(model, pipe_run.model)
+    verify_run(model, pipe_run, [g.name for g in pipe_run.goal_results],
+               proposals=pipe_props)
+
+    def side(run, wall, fetches):
+        return {
+            "wall_s": round(wall, 3),
+            "steps": sum(g.steps for g in run.goal_results),
+            "actions": sum(g.actions_applied for g in run.goal_results),
+            "fetches": fetches["device_fetches"],
+            "chunks_dispatched": fetches["chunks_dispatched"],
+            "goals_skipped": run.goals_skipped,
+        }
+
+    speedup = seq_wall / max(pipe_wall, 1e-9)
+    rec = {
+        "metric": f"pipeline_stack_speedup_{scale}",
+        "value": round(speedup, 2),
+        "unit": "x",
+        # Acceptance bar: pipelined stack ≥ 1.3× the sequential twin.
+        "vs_baseline": round(speedup / 1.3, 3),
+        "num_brokers": brokers,
+        "num_replicas": num_replicas,
+        "num_proposals": len(pipe_props),
+        "bit_identical": identical,
+        "equisatisfying": equisat,
+        "goals_overlapped": pipe_run.goals_overlapped,
+        "goals_fused": pipe_run.goals_fused,
+        "sequential": side(seq_run, seq_wall, seq_f),
+        "pipelined": side(pipe_run, pipe_wall, pipe_f),
+        # Per-goal overlap economy of the pipelined pass: a negative
+        # boundary_gap_s means the goal's first chunk was dispatched BEFORE
+        # its predecessor's boundary (real overlap);
+        # tools/dispatch_report.py and tail_report.py render these.
+        "per_goal": {g.name: {
+            "steps": g.steps, "actions": g.actions_applied,
+            "wall_s": round(g.duration_s, 3),
+            "satisfied_after": g.satisfied_after,
+            "pipelined": g.pipelined,
+            "boundary_gap_s": round(g.boundary_gap_s, 4),
+            "chunks_cross_goal": g.chunks_cross_goal,
+            "chunks_cross_wasted": g.chunks_cross_wasted,
+            "fused_group": g.fused_group,
+        } for g in pipe_run.goal_results},
+        **({"fast_mode": True} if fast else {}),
+    }
+    if os.environ.get("BENCH_CEILING_PROBE", "1") != "0":
+        rec["compile_ceiling_probe"] = _compile_ceiling_probe(
+            BalancingConstraint.default(), OptimizationOptions)
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        f"PIPELINE_{scale}.json")
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1, sort_keys=True)
+        f.write("\n")
+    rec["pipeline_artifact"] = os.path.basename(path)
+    return rec
+
+
 def main() -> None:
     # Rung selection: --rungs flag > BENCH_SCALE env > default small,mid.
     # The default deliberately stops at mid (~10k replicas): it is the
@@ -601,12 +807,20 @@ def main() -> None:
                          "warm (seeded from the previous converged "
                          "placement), write WARM_<rung>.json with both "
                          "flight timelines (default rung: mid)")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="run the inter-goal pipelining twin rung(s) "
+                         "instead: solve the stack sequentially AND "
+                         "pipelined from the same snapshot (bit-identity, "
+                         "equisatisfaction and verifier enforced in-rung), "
+                         "write PIPELINE_<rung>.json with the compile-"
+                         "ceiling probe (default rung: mid)")
     args = ap.parse_args()
     if args.flight or args.warm:
         # --warm always records flight telemetry: the WARM artifact's whole
         # point is the cold-vs-warm convergence overlay.
         os.environ["CRUISE_FLIGHT_RECORDER"] = "1"
-    default_rungs = "mid" if (args.execute or args.warm) else "small,mid"
+    default_rungs = ("mid" if (args.execute or args.warm or args.pipeline)
+                     else "small,mid")
     scale_sel = args.rungs or os.environ.get("BENCH_SCALE") or default_rungs
     scales = (["small", "mid", "large"] if scale_sel == "ladder"
               else [s.strip() for s in scale_sel.split(",") if s.strip()])
@@ -644,11 +858,13 @@ def main() -> None:
         # by the suite; never set in real runs.
         metric = ("execution_wall_to_balanced_small" if args.execute
                   else "warm_vs_cold_speedup_small" if args.warm
+                  else "pipeline_stack_speedup_small" if args.pipeline
                   else "wall_clock_to_goal_satisfying_proposal_small")
         _record_rung({"metric": metric, "value": 0.0, "unit": "s",
                       "vs_baseline": 0.0, "selftest": True,
                       **({"execute": True} if args.execute else {}),
-                      **({"warm": True} if args.warm else {})})
+                      **({"warm": True} if args.warm else {}),
+                      **({"pipeline": True} if args.pipeline else {})})
         while True:
             signal.pause()
 
@@ -667,6 +883,8 @@ def main() -> None:
         cancel = _watchdog(rung_timeout, f"rung_timeout_{s}")
         rec = (run_execute_rung(s, max_candidates, fast) if args.execute
                else run_warm_rung(s, max_candidates, fast) if args.warm
+               else run_pipeline_rung(s, max_candidates, fast)
+               if args.pipeline
                else run_rung(s, max_candidates, fast))
         cancel()
         rec["backend"] = platform

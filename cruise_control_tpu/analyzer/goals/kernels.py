@@ -909,6 +909,25 @@ def is_band_kind(spec: GoalSpec) -> bool:
     return spec.kind in _BAND_KINDS
 
 
+def frontier_active_batch(specs, model: TensorClusterModel,
+                          arrays: BrokerArrays,
+                          constraint: BalancingConstraint) -> Array:
+    """bool[S, B] — ``frontier_active`` for every spec in one fused graph.
+
+    Non-band specs get an all-False row (they run the dense path; their
+    "frontier" carries no information).  The stack sweep stacks these rows
+    next to the satisfaction bits so ONE dispatch predicts every goal's
+    frontier — the inter-goal pipeline's grouping and conflict masks are
+    all derived from this matrix.  Like ``frontier_active`` itself the rows
+    are performance hints, not correctness gates.
+    """
+    B = model.num_brokers
+    return jnp.stack([
+        frontier_active(s, model, arrays, constraint) if is_band_kind(s)
+        else jnp.zeros((B,), bool)
+        for s in specs])
+
+
 def accepts_band_batch(specs, model: TensorClusterModel, arrays: BrokerArrays,
                        cand: Candidates, constraint: BalancingConstraint) -> Array:
     """bool[K] — AND of ``accepts`` over all band-kind ``specs``.

@@ -55,9 +55,12 @@ from cruise_control_tpu.analyzer.state import (FLIGHT_ACTIONS, FLIGHT_BISECT,
                                                FLIGHT_FRONTIER, FLIGHT_KIND,
                                                FLIGHT_LANES, FLIGHT_REPAIR,
                                                FLIGHT_SCORE_BITS, FLIGHT_WIDTH,
-                                               PACKED_CAPPED, BrokerArrays,
+                                               PACKED_AFTER, PACKED_ANY_OFFLINE,
+                                               PACKED_CAPPED, PACKED_CONFLICT,
+                                               BrokerArrays,
                                                FrontierInvariants,
                                                OptimizationOptions,
+                                               PipelineNextGoal,
                                                StepInvariants, WarmStart,
                                                pow2_bucket)
 from cruise_control_tpu.common import compile_cache
@@ -1356,12 +1359,23 @@ def _frontier_bucket(num_active: int, num_brokers: int) -> Optional[int]:
     return bucket
 
 
-def _frontier_widths(bucket: int, ns: int, nd: int):
+def _frontier_widths(bucket: int, ns: int, nd: int, lanes: int = 1):
     """(ns, nd) for a compacted chunk: candidate widths shrink with the
     frontier — the K = S·D batch is where per-step cost actually lives, and
     an active set of Bc brokers can neither source nor sink more than a few
-    replicas per broker per step.  Floors keep exploration alive."""
-    return (max(1, min(ns, max(32, 4 * bucket))), max(1, min(nd, bucket)))
+    replicas per broker per step.  Floors keep exploration alive.
+
+    ``lanes`` (mesh size under ``distributed_frontier_fixpoint``) rounds
+    each width UP to a lane multiple so the compacted candidate batch
+    shards evenly over the mesh axis — GSPMD handles ragged shardings by
+    padding anyway; rounding on the host keeps every chip's slice identical
+    and the compacted executables shape-stable across bucket transitions."""
+    cns = max(1, min(ns, max(32, 4 * bucket)))
+    cnd = max(1, min(nd, bucket))
+    if lanes > 1:
+        cns = -(-cns // lanes) * lanes
+        cnd = -(-cnd // lanes) * lanes
+    return cns, cnd
 
 
 def _build_frontier(active_np: np.ndarray, bucket: int) -> FrontierInvariants:
@@ -1385,6 +1399,10 @@ def _build_frontier(active_np: np.ndarray, bucket: int) -> FrontierInvariants:
 # (GoalOptimizer.device-fetches / chunks-speculative / chunks-wasted).
 FETCH_COUNTERS = {"device_fetches": 0, "chunks_dispatched": 0,
                   "chunks_speculative": 0, "chunks_wasted": 0,
+                  # Cross-goal pipeline: opening chunks of goal N+1 launched
+                  # while goal N's tail drained, and the subset whose
+                  # on-device conflict/convergence gate zeroed them.
+                  "chunks_cross_goal": 0, "chunks_cross_wasted": 0,
                   # Bytes of flight-recorder buffers that rode the boundary
                   # fetches (0 with CRUISE_FLIGHT_RECORDER off) — lets the
                   # dispatch audit attribute recorder traffic separately
@@ -1392,6 +1410,7 @@ FETCH_COUNTERS = {"device_fetches": 0, "chunks_dispatched": 0,
                   "flight_bytes": 0}
 
 _gate_fn = None
+_cross_gate_fn = None
 
 
 def _get_gate_fn():
@@ -1406,6 +1425,27 @@ def _get_gate_fn():
         _gate_fn = jax.jit(
             lambda packed, budget: packed[PACKED_CAPPED] * budget)
     return _gate_fn
+
+
+def _get_cross_gate_fn():
+    """Jitted cross-GOAL budget gate: the next goal's speculative opening
+    chunk may only run when the current goal's chunk proved the goal DONE
+    (satisfied, not capped, no offline replicas left — the same exit test
+    the host makes after its fetch) AND no broker the stack has touched
+    since the frontier sweep lies inside the next goal's predicted seed
+    frontier (``PACKED_CONFLICT`` == 0).  Any other outcome collapses the
+    opener to a zero-step no-op, bit-identical to never dispatching it —
+    this is the PR-5 speculation gate extended across the goal boundary."""
+    global _cross_gate_fn
+    if _cross_gate_fn is None:
+        _cross_gate_fn = jax.jit(
+            lambda packed, budget: jnp.where(
+                (packed[PACKED_AFTER] == 1)
+                & (packed[PACKED_CAPPED] == 0)
+                & (packed[PACKED_ANY_OFFLINE] == 0)
+                & (packed[PACKED_CONFLICT] == 0),
+                budget, 0))
+    return _cross_gate_fn
 
 
 def _flight_step_dicts(rows, start_step: int, chunk_index: int) -> List[dict]:
@@ -1438,7 +1478,8 @@ def _flight_step_dicts(rows, start_step: int, chunk_index: int) -> List[dict]:
 
 def _goal_fixpoint_budget(model: TensorClusterModel,
                           options: OptimizationOptions,
-                          step_budget, frontier=None, *, spec=None,
+                          step_budget, frontier=None, touched=None,
+                          next_mask=None, *, spec=None,
                           prev_specs=(), constraint=None, num_sources=None,
                           num_dests=None, mesh=None, repair_oracle=False,
                           flight_capacity: int = 0):
@@ -1470,8 +1511,21 @@ def _goal_fixpoint_budget(model: TensorClusterModel,
     (see state.py), the body writes one row per executed step, and the
     return becomes ``(model, packed, active, flight)`` — the buffer rides
     the same boundary fetch as the packed stats.  Capacity 0 compiles the
-    exact pre-recorder graph and keeps the 3-tuple return."""
+    exact pre-recorder graph and keeps the 3-tuple return.
+
+    ``touched`` (traced bool[B], inter-goal pipeline accounting) is the
+    broker-touched accumulator since the last frontier sweep: the chunk
+    ORs in every broker whose replica set it changed (entry-vs-exit
+    placement diff — exact, no step-loop plumbing) and appends
+    ``touched_out`` to the return tuple.  With ``next_mask`` (traced
+    bool[B], the next goal's PREDICTED seed frontier) the packed
+    ``PACKED_CONFLICT`` slot carries ``|touched_out ∩ next_mask|`` so the
+    cross-goal speculation gate can discard a prelaunched opener entirely
+    on device.  Both default to None, which compiles the exact
+    pre-pipeline graph (conflict slot constant 0, no extra output)."""
     flight = flight_capacity > 0
+    rb0, rl0, rd0 = (model.replica_broker, model.replica_is_leader,
+                     model.replica_disk)
     arrays0 = BrokerArrays.from_model(model)
     before = kernels.goal_satisfied(spec, model, arrays0, constraint)
     any_offline = (model.replica_offline_now() & model.replica_valid).any()
@@ -1521,13 +1575,35 @@ def _goal_fixpoint_budget(model: TensorClusterModel,
     else:
         active = jnp.zeros((model.num_brokers,), dtype=bool)
         num_active = jnp.int32(-1)
+    conflict = jnp.int32(0)
+    touched_out = None
+    if touched is not None:
+        # Exact touched-broker accounting from the entry-vs-exit placement
+        # diff: any replica whose broker/disk/leadership changed marks BOTH
+        # its entry and exit brokers (two B-sized scatter-adds — noise next
+        # to the step loop).  Validity is move-invariant, so the entry mask
+        # covers both sides.
+        B = model.num_brokers
+        moved = model.replica_valid & (
+            (model.replica_broker != rb0) | (model.replica_is_leader != rl0)
+            | (model.replica_disk != rd0))
+        m_i = moved.astype(jnp.int32)
+        hits = (jnp.zeros((B,), jnp.int32)
+                .at[jnp.clip(rb0, 0, B - 1)].add(m_i)
+                .at[jnp.clip(model.replica_broker, 0, B - 1)].add(m_i))
+        touched_out = touched | (hits > 0)
+        if next_mask is not None:
+            conflict = (touched_out & next_mask).sum().astype(jnp.int32)
     packed = jnp.stack([steps, total, before.astype(jnp.int32),
                         after.astype(jnp.int32), capped.astype(jnp.int32),
                         rep, dep, lan, num_active,
-                        off_after.astype(jnp.int32)])
+                        off_after.astype(jnp.int32), conflict])
+    out = (model, packed, active)
+    if touched is not None:
+        out = out + (touched_out,)
     if flight:
-        return model, packed, active, final[7]
-    return model, packed, active
+        out = out + (final[7],)
+    return out
 
 
 _budget_cache: Dict[tuple, object] = {}
@@ -1561,7 +1637,9 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
                       mesh=None, donate: bool = False, frontier: bool = True,
                       tail_threshold: float = 0.1, min_chunk: int = 4,
                       on_chunk=None, speculate: Optional[bool] = None,
-                      seed_active=None):
+                      seed_active=None,
+                      next_goal: Optional[PipelineNextGoal] = None,
+                      prelaunch: Optional[dict] = None):
     """Async chunked driver for one goal's fixpoint.  Returns
     ``(model, info)`` where info = {chunks, buckets, fresh_compile, steps,
     actions, satisfied_before, satisfied_after, capped, repair_steps,
@@ -1626,6 +1704,21 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
     a dense chunk before the goal is declared done, so a mask that misses a
     needed broker costs one confirm chunk, never correctness.  ``None``
     leaves the driver's behavior bit-identical to the unseeded path.
+
+    **Inter-goal pipelining** (``next_goal`` / ``prelaunch``): with a
+    ``PipelineNextGoal`` descriptor the driver speculatively dispatches the
+    NEXT goal's first chunk off every authoritative chunk of its own goal,
+    budget-gated ON DEVICE by this chunk's packed stats — the opener only
+    runs when the current goal is provably DONE (satisfied, uncapped,
+    nothing offline) and no move since the frontier sweep landed inside the
+    next goal's predicted seed frontier (``PACKED_CONFLICT``); otherwise it
+    traces as a bit-exact zero-step passthrough and is discarded.  An
+    adopted opener is returned in ``info["handoff"]`` so the next driver
+    invocation can resume from it via ``prelaunch`` without a fresh
+    dispatch.  Pipelined drivers thread a ``touched`` broker mask through
+    EVERY dispatch (the 6-arg trace) so all chunks of one (goal, bucket,
+    flight_cap) still share ONE executable; non-pipelined callers keep the
+    4-arg form and their pre-pipeline graphs byte-identical.
     """
     ns = num_sources or cgen.default_num_sources(model)
     nd = num_dests or cgen.default_num_dests(model)
@@ -1669,23 +1762,93 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
             bucket = nb
             fr = _build_frontier(seed_np, nb)
             seeded = int(seed_np.sum())
+    # Inter-goal pipelining state.  ``pipelined`` switches every dispatch
+    # to the 6-arg trace (touched mask + next-goal seed mask ride through
+    # the program) so the conflict slot is live; the opener config mirrors
+    # the first-chunk policy the next driver invocation would use itself.
+    pipelined = next_goal is not None or prelaunch is not None
+    touched_d = None
+    next_mask_d = None
+    opener_bucket: Optional[int] = None
+    opener_fr: Optional[FrontierInvariants] = None
+    opener_blen = 0
+    opener_fcap = 0
+    opener_seeded = 0
+    cross_dispatched = 0
+    cross_wasted = 0
+    handoff: Optional[dict] = None
+    if pipelined:
+        touched_d = (prelaunch["touched"] if prelaunch is not None
+                     else jnp.zeros((B,), bool))
+        next_mask_d = jnp.zeros((B,), bool)
+        if next_goal is not None:
+            opener_fcap = (min(next_goal.chunk_len, next_goal.max_steps)
+                           if _flight_recorder() else 0)
+            grow_n = next_goal.chunk_len < next_goal.max_steps
+            opener_blen = max(1, min(
+                next_goal.min_chunk if grow_n else next_goal.chunk_len,
+                next_goal.chunk_len, next_goal.max_steps))
+            if bool(frontier) and kernels.is_band_kind(next_goal.spec) \
+                    and next_goal.seed_active is not None:
+                nseed = np.asarray(next_goal.seed_active, dtype=bool)
+                nb = _frontier_bucket(int(nseed.sum()), B)
+                if nb is not None:
+                    opener_bucket = nb
+                    opener_fr = _build_frontier(nseed, nb)
+                    opener_seeded = int(nseed.sum())
+                    # Conflict accounting only protects COMPACTED openers;
+                    # a dense opener sees every broker and is always valid,
+                    # so it keeps the all-zeros mask (never discarded for
+                    # frontier staleness).
+                    next_mask_d = jnp.asarray(nseed)
     pending: Optional[dict] = None  # the one in-flight speculative chunk
+    t_first_dispatch: Optional[float] = None
+    if prelaunch is not None:
+        # Adopt the opener the PREVIOUS driver dispatched for this goal:
+        # it becomes the first in-flight chunk and the existing pop/fetch
+        # machinery treats it exactly like a chunk this driver launched.
+        pending = prelaunch
+        t_first_dispatch = prelaunch.get("t_dispatch")
+        seeded = prelaunch.get("seeded", 0) or seeded
     t_prev = time.monotonic()
 
-    def _dispatch(bucket, fr, budget, blen, speculative, confirm=False):
+    def _dispatch(bucket, fr, budget, blen, speculative, confirm=False,
+                  spec_d=None, prev_d=None, fcap=None, cross=False):
         """Launch one chunk (async) and return its in-flight record.
         ``budget`` is a host int for decided chunks or a device scalar for
         gated speculative ones; both trace as strong i32 so every chunk of
-        one bucket shape shares ONE executable."""
-        nonlocal model, fresh, speculated
-        cns, cnd = (ns, nd) if bucket is None else _frontier_widths(bucket,
-                                                                    ns, nd)
-        fn = _get_budget_fixpoint_fn(spec, prev_specs, constraint, cns, cnd,
+        one bucket shape shares ONE executable.  ``spec_d``/``prev_d``/
+        ``fcap`` override the goal context for CROSS-GOAL openers (the next
+        goal's first chunk launched while this goal's tail drains);
+        defaults dispatch the driver's own goal."""
+        nonlocal model, fresh, speculated, touched_d, t_first_dispatch
+        sp = spec_d if spec_d is not None else spec
+        pv = prev_d if prev_d is not None else prev_specs
+        fc = flight_cap if fcap is None else fcap
+        # Under a mesh the compacted candidate batch shards over the search
+        # axis like the dense batch does — widths round up to lane
+        # multiples so every device gets an equal slice of the bucket.
+        lanes = int(mesh.devices.size) if mesh is not None else 1
+        cns, cnd = (ns, nd) if bucket is None else _frontier_widths(
+            bucket, ns, nd, lanes)
+        fn = _get_budget_fixpoint_fn(sp, pv, constraint, cns, cnd,
                                      mesh=mesh, donate=donate,
-                                     flight_capacity=flight_cap)
+                                     flight_capacity=fc)
         size0 = fn._cache_size() if hasattr(fn, "_cache_size") else None
-        bud = budget if speculative else jnp.int32(budget)
-        if flight_cap:
+        bud = jnp.int32(budget) if isinstance(budget, int) else budget
+        if pipelined:
+            # 6-arg trace: the opener's conflict slot is meaningless for
+            # the NEXT driver's own next goal, so cross dispatches carry an
+            # all-zeros mask (their conflict slot is never consulted).
+            mask = next_mask_d if spec_d is None else jnp.zeros((B,), bool)
+            if fc:
+                model, packed_d, active_d, touched_d, flight_d = fn(
+                    model, options, bud, fr, touched_d, mask)
+            else:
+                model, packed_d, active_d, touched_d = fn(
+                    model, options, bud, fr, touched_d, mask)
+                flight_d = None
+        elif fc:
             model, packed_d, active_d, flight_d = fn(model, options, bud, fr)
         else:
             model, packed_d, active_d = fn(model, options, bud, fr)
@@ -1699,21 +1862,27 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
             # same way the stack path does: a persistent-cache marker means
             # some process already built this executable (warm disk cache).
             token = _persist_token(
-                "budget", (spec, prev_specs, constraint, cns, cnd, mesh,
+                "budget", (sp, pv, constraint, cns, cnd, mesh,
                            donate, bucket)
-                + ((flight_cap,) if flight_cap else ()), model, options)
+                + ((fc,) if fc else ()), model, options)
             if not (token and compile_cache.seen(token)):
                 fresh = True
             if token:
                 compile_cache.mark(token)
         FETCH_COUNTERS["chunks_dispatched"] += 1
+        if cross:
+            FETCH_COUNTERS["chunks_cross_goal"] += 1
         if speculative:
             FETCH_COUNTERS["chunks_speculative"] += 1
             speculated += 1
+        now = time.monotonic()
+        if t_first_dispatch is None:
+            t_first_dispatch = now
         return {"packed": packed_d, "active": active_d, "flight": flight_d,
                 "bucket": bucket, "fr": fr, "ns": cns, "nd": cnd,
                 "blen": blen, "fresh": chunk_fresh,
-                "speculative": speculative, "confirm": confirm}
+                "speculative": speculative, "confirm": confirm,
+                "cross": cross, "t_dispatch": now}
 
     while steps_done < max_steps:
         if pending is not None:
@@ -1741,6 +1910,28 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
                 gated = _get_gate_fn()(cur["packed"], jnp.int32(nxt))
                 pending = _dispatch(cur["bucket"], cur["fr"], gated, nxt,
                                     True)
+        cross_rec: Optional[dict] = None
+        if (next_goal is not None and speculate and cur["fr"] is None
+                and not cur["speculative"] and not cur.get("cross")):
+            # Speculatively open the NEXT goal's first chunk while this
+            # goal's authoritative chunk drains.  The on-device gate
+            # releases the opener's budget only when this chunk proves the
+            # goal DONE (satisfied, uncapped, nothing offline) AND no move
+            # since the frontier sweep landed inside the next goal's
+            # predicted seed frontier; otherwise the opener is a bit-exact
+            # zero-step passthrough, discarded at the fetch below.  Openers
+            # hang ONLY off authoritative chunks (``fr is None``) — a
+            # compacted convergence still needs its dense confirm — and
+            # never off an adopted prelaunch, whose conflict slot was
+            # computed against the PREVIOUS driver's mask.
+            gated = _get_cross_gate_fn()(cur["packed"],
+                                         jnp.int32(opener_blen))
+            cross_rec = _dispatch(opener_bucket, opener_fr, gated,
+                                  opener_blen, False,
+                                  spec_d=next_goal.spec,
+                                  prev_d=next_goal.prev_specs,
+                                  fcap=opener_fcap, cross=True)
+            cross_dispatched += 1
         t_f = time.monotonic()
         # ONE blocking transfer per boundary, recorder or not: the flight
         # buffer (when present) joins the same device_get tuple.
@@ -1768,7 +1959,7 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
         # overlap).
         wall = now - t_prev
         t_prev = now
-        (s, a, b4, aft, cap, rep, dep, lan, na, off) = (
+        (s, a, b4, aft, cap, rep, dep, lan, na, off, conf) = (
             int(x) for x in np.asarray(packed_np))
         if before0 is None:
             before0 = bool(b4)
@@ -1827,6 +2018,17 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
                 wasted += 1
                 FETCH_COUNTERS["chunks_wasted"] += 1
                 pending = None
+            if cross_rec is not None:
+                # Host decision mirrors the on-device gate exactly (same
+                # packed values, same predicate): adopt the opener as the
+                # next goal's first in-flight chunk, or discard the
+                # passthrough.
+                if conf == 0 and not cap:
+                    handoff = dict(cross_rec, touched=touched_d,
+                                   seeded=opener_seeded)
+                else:
+                    cross_wasted += 1
+                    FETCH_COUNTERS["chunks_cross_wasted"] += 1
             capped = False
             break
         if not capped:
@@ -1835,7 +2037,17 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
                 wasted += 1
                 FETCH_COUNTERS["chunks_wasted"] += 1
                 pending = None
+            if cross_rec is not None:
+                # Converged but unsatisfied (or offline left) — the gate
+                # required ``after``, so the opener was a passthrough.
+                cross_wasted += 1
+                FETCH_COUNTERS["chunks_cross_wasted"] += 1
             break  # dense convergence is authoritative
+        if cross_rec is not None:
+            # Capped — the gate required ``capped == 0``, so the opener
+            # was a passthrough.
+            cross_wasted += 1
+            FETCH_COUNTERS["chunks_cross_wasted"] += 1
         # Capped: pick the next host-decided config from the mask that
         # rode along with the chunk.  With a follow-up already in flight
         # this takes effect one chunk late — the speculative chunk runs on
@@ -1859,6 +2071,12 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
             "chunks_wasted": wasted}
     if seeded:
         info["seed_frontier"] = seeded
+    if pipelined:
+        info["cross_dispatched"] = cross_dispatched
+        info["cross_wasted"] = cross_wasted
+        info["handoff"] = handoff
+        info["t_first_dispatch"] = t_first_dispatch
+        info["adopted_prelaunch"] = prelaunch is not None
     if flight_cap:
         info["flight"] = {"kinds": list(FLIGHT_KINDS),
                           "steps": flight_steps, "chunks": flight_chunks}
@@ -1879,6 +2097,22 @@ def _stack_satisfied(model: TensorClusterModel, *, specs=(), constraint=None):
     return sat, any_offline
 
 
+def _stack_frontiers(model: TensorClusterModel, *, specs=(), constraint=None):
+    """One fused sweep answering BOTH stack questions for pipelining:
+    per-goal satisfaction (as ``_stack_satisfied``) plus every goal's
+    predicted frontier — bool[G, B], all-False rows for non-band goals —
+    in a single dispatch.  The frontiers seed next-goal openers and decide
+    disjoint-frontier fusion; they are predictions (performance hints),
+    never correctness gates, so staleness costs a discarded opener or a
+    confirm chunk, not a wrong answer."""
+    arrays = BrokerArrays.from_model(model)
+    sat = jnp.stack([kernels.goal_satisfied(s, model, arrays, constraint)
+                     for s in specs])
+    any_offline = (model.replica_offline_now() & model.replica_valid).any()
+    fronts = kernels.frontier_active_batch(specs, model, arrays, constraint)
+    return sat, any_offline, fronts
+
+
 _sweep_cache: Dict[tuple, object] = {}
 
 
@@ -1888,6 +2122,17 @@ def _get_sweep_fn(specs: Tuple[GoalSpec, ...],
     fn = _sweep_cache.get(key)
     if fn is None:
         fn = jax.jit(partial(_stack_satisfied, specs=specs,
+                             constraint=constraint))
+        _sweep_cache[key] = fn
+    return fn
+
+
+def _get_frontier_sweep_fn(specs: Tuple[GoalSpec, ...],
+                           constraint: BalancingConstraint):
+    key = (specs, constraint, "fronts")
+    fn = _sweep_cache.get(key)
+    if fn is None:
+        fn = jax.jit(partial(_stack_frontiers, specs=specs,
                              constraint=constraint))
         _sweep_cache[key] = fn
     return fn
@@ -2139,6 +2384,40 @@ def _push_warm_sensors(seed_frontier_size: int, goals_skipped: int) -> None:
     ).observe(seed_frontier_size)
 
 
+def _push_pipeline_sensors(goals_overlapped: int, cross_wasted: int,
+                           fill_ratio: float, goals_fused: int) -> None:
+    """Inter-goal pipelining counters into the sensor registry — one
+    report per pipelined ``_optimize`` pass."""
+    SENSORS.counter(
+        "GoalOptimizer.goals-overlapped",
+        help="Goal transitions whose first chunk was already in flight "
+             "when the previous goal finished (adopted cross-goal openers)",
+    ).inc(goals_overlapped)
+    SENSORS.counter(
+        "GoalOptimizer.speculative-goal-chunks-wasted",
+        help="Cross-goal opener chunks discarded because the gating goal "
+             "capped, left offline replicas, or touched the next goal's "
+             "predicted seed frontier",
+    ).inc(cross_wasted)
+    SENSORS.gauge(
+        "GoalOptimizer.pipeline-fill-ratio",
+        help="Adopted cross-goal openers over goal transitions in the "
+             "last pipelined optimization pass",
+    ).set(fill_ratio)
+    SENSORS.counter(
+        "GoalOptimizer.goals-fused",
+        help="Goals that ran inside an auto-fused disjoint-frontier stack "
+             "program instead of their own per-goal driver",
+    ).inc(goals_fused)
+
+
+# Size cap for auto-fused disjoint-frontier groups: chaining more goals in
+# one program stops paying off once the program's step budget dwarfs the
+# per-goal dispatch overhead, and big multi-goal programs are exactly what
+# the tunneled-TPU guard below exists to avoid.
+_FUSE_MAX = 4
+
+
 _stack_cache: Dict[tuple, object] = {}
 
 
@@ -2209,6 +2488,19 @@ class GoalResult:
     # _flight_step_dicts for the per-step schema) when the goal ran with
     # CRUISE_FLIGHT_RECORDER=1; None with the recorder off.
     flight: Optional[dict] = None
+    # Inter-goal pipelining (pipelined per-goal path only): True when this
+    # goal's first chunk was a cross-goal opener adopted from the previous
+    # goal's driver; the signed gap between the previous goal's end and
+    # this goal's first dispatch (negative = the dispatch preceded the
+    # boundary, i.e. real overlap); and this goal's own opener
+    # dispatch/discard counts toward its successor.
+    pipelined: bool = False
+    boundary_gap_s: float = 0.0
+    chunks_cross_goal: int = 0
+    chunks_cross_wasted: int = 0
+    # Goals this result's program was auto-fused with under the
+    # disjoint-frontier grouping; 1 = ran alone.
+    fused_group: int = 1
 
 
 @dataclasses.dataclass
@@ -2232,6 +2524,13 @@ class OptimizerRun:
     warm: bool = False
     seed_frontier_size: int = 0
     goals_skipped: int = 0
+    # Inter-goal pipelining accounting: whether the pass ran the pipelined
+    # per-goal path, how many goal transitions adopted an in-flight
+    # cross-goal opener, and how many goals ran inside auto-fused
+    # disjoint-frontier groups.
+    pipelined: bool = False
+    goals_overlapped: int = 0
+    goals_fused: int = 0
 
     @property
     def violated_goals_before(self) -> List[str]:
@@ -2271,7 +2570,8 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
              balancedness_strictness_weight: float = 1.5,
              mesh=None, donate_model: bool = False,
              frontier: Optional[bool] = None,
-             warm_start: Optional[WarmStart] = None) -> OptimizerRun:
+             warm_start: Optional[WarmStart] = None,
+             pipeline: Optional[bool] = None) -> OptimizerRun:
     """Traced entry point around ``_optimize`` (see its docstring for the
     optimization semantics): the whole pass runs inside an
     ``analyzer.optimize`` span, and each goal's fixpoint stats (steps,
@@ -2292,12 +2592,19 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
                         balancedness_priority_weight=balancedness_priority_weight,
                         balancedness_strictness_weight=balancedness_strictness_weight,
                         mesh=mesh, donate_model=donate_model,
-                        frontier=frontier, warm_start=warm_start)
+                        frontier=frontier, warm_start=warm_start,
+                        pipeline=pipeline)
         warm_attrs = ({"warm": True,
                        "seed_frontier_size": run.seed_frontier_size,
                        "goals_skipped": run.goals_skipped}
                       if run.warm else {})
         for g in run.goal_results:
+            pipe_attrs = ({"pipelined": g.pipelined,
+                           "boundary_gap_s": g.boundary_gap_s,
+                           "chunks_cross_goal": g.chunks_cross_goal,
+                           "chunks_cross_wasted": g.chunks_cross_wasted,
+                           "fused_group": g.fused_group}
+                          if run.pipelined else {})
             TRACE.record("analyzer.goal", g.duration_s, goal=g.name,
                          steps=g.steps, actions=g.actions_applied,
                          satisfied_after=g.satisfied_after, capped=g.capped,
@@ -2309,11 +2616,15 @@ def optimize(model: TensorClusterModel, goal_names: Sequence[str],
                          chunks_speculative=g.chunks_speculative,
                          chunks_wasted=g.chunks_wasted,
                          **warm_attrs,
+                         **pipe_attrs,
                          **({"flight": g.flight}
                             if g.flight is not None else {}))
         sp.annotate(actions=sum(g.actions_applied for g in run.goal_results),
                     steps=sum(g.steps for g in run.goal_results),
-                    candidates_scored=run.num_candidates_scored)
+                    candidates_scored=run.num_candidates_scored,
+                    pipelined=run.pipelined,
+                    goals_overlapped=run.goals_overlapped,
+                    goals_fused=run.goals_fused)
         return run
 
 
@@ -2333,7 +2644,8 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
               balancedness_strictness_weight: float = 1.5,
               mesh=None, donate_model: bool = False,
               frontier: Optional[bool] = None,
-              warm_start: Optional[WarmStart] = None) -> OptimizerRun:
+              warm_start: Optional[WarmStart] = None,
+              pipeline: Optional[bool] = None) -> OptimizerRun:
     """Run the goal stack in priority order (GoalOptimizer.optimizations).
 
     Each goal optimizes the model to its fixpoint, constrained by the
@@ -2377,6 +2689,19 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
     dense path, True forces the frontier policy (still dense below the
     floor and for non-band goals).  The multi-goal-chunk and unfused paths
     always run dense.
+
+    ``pipeline`` controls inter-goal pipelining on the fused per-goal path:
+    one fused sweep predicts every goal's satisfaction AND frontier,
+    adjacent unsatisfied band goals with pairwise-disjoint predicted
+    frontiers auto-fuse into one stack program, and singleton goals
+    speculatively open their successor's first chunk while their own tail
+    drains (discarded by an on-device conflict gate whenever the running
+    goal mutates a broker inside the successor's predicted seed frontier —
+    results stay bit-identical to sequential stepping).  ``None`` (default)
+    engages it automatically when the per-goal chunking default kicked in
+    (no manual ``fuse_group_size``) and the cluster exceeds the frontier
+    floor; ``True`` forces it (requires per-goal chunking); ``False`` — or
+    ``CRUISE_PIPELINE=0`` in the environment — keeps the sequential loop.
 
     ``warm_start`` seeds the solve from a previously-converged placement
     (cruise mode): the fresh model's replica placement is re-based onto
@@ -2455,6 +2780,12 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
             "off to disable)", ceiling, ns0, ns, nd0, nd)
     scored = 0
     goals_skipped = 0
+    pipelined_run = False
+    goals_overlapped = 0
+    goals_fused = 0
+    if pipeline and not fused:
+        raise ValueError("pipeline=True requires fused=True (the fused "
+                         "per-goal path)")
 
     def k_of(spec: GoalSpec, ns_k: Optional[int] = None,
              nd_k: Optional[int] = None) -> int:
@@ -2481,9 +2812,33 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
         # round-trip cost of chunking is one transfer regardless of chunk
         # count.  EVERY fused caller (service facade included) gets the
         # safe default, not just the bench.
+        manual_group = fuse_group_size
         if fuse_group_size is None and model.num_brokers >= 100:
             fuse_group_size = 1
         group = fuse_group_size or len(specs) or 1
+        if pipeline:
+            if manual_group is not None and manual_group > 1:
+                raise ValueError(
+                    "pipeline=True requires per-goal chunking; pass "
+                    "fuse_group_size=1 (or omit it) when pipelining")
+        pipe = pipeline
+        if pipe is None:
+            # Auto policy: above the frontier threshold the per-goal
+            # drivers already amortize their boundaries, so inter-goal
+            # overlap is pure win; a manual fuse_group_size is a caller
+            # opt-out.  Below the threshold the whole-stack program is one
+            # dispatch — nothing to overlap.
+            pipe = (manual_group is None
+                    and model.num_brokers > _FRONTIER_DENSE_MIN)
+        env_p = os.environ.get("CRUISE_PIPELINE", "").strip().lower()
+        if env_p in ("0", "off", "false", "no"):
+            pipe = False
+        if pipe:
+            # The pipeline IS the grouping policy: per-goal chunk drivers
+            # with speculative next-goal openers, plus automatic
+            # disjoint-frontier fusion replacing the manual whole-stack
+            # grouping.
+            group = 1
         # At ≥500-broker shapes a single goal's full fixpoint can run many
         # minutes inside ONE dispatch, and the tunneled TPU worker kills
         # long executions ("TPU worker process crashed").  Segment each
@@ -2509,82 +2864,321 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
             # across all goals: bench showed 16 identical 0.057 s entries).
             use_frontier = (frontier if frontier is not None
                             else model.num_brokers > _FRONTIER_DENSE_MIN)
-            sweep_fn = _get_sweep_fn(tuple(specs), constraint)
-            sat_v = None
-            sweep_off = False
-            prev: Tuple[GoalSpec, ...] = ()
-            for spec in specs:
-                tg = time.monotonic()
-                i = len(results)
-                if sat_v is None:
-                    # ONE jitted dispatch answers "already satisfied?" for
-                    # the WHOLE stack; it stays valid until some goal
-                    # mutates the model, then re-dispatches the same
-                    # program (one compile total).
-                    SWEEP_COUNTERS["dispatches"] += 1
-                    sat_np, off_np = jax.device_get(sweep_fn(model))
-                    sat_v = np.asarray(sat_np)
-                    sweep_off = bool(off_np)
-                if bool(sat_v[i]) and not sweep_off:
-                    # The same decision _goal_fixpoint's skip shortcut
-                    # makes (satisfied + no offline replicas → zero steps,
-                    # before == after), minus the fixpoint-program entry.
-                    SWEEP_COUNTERS["skipped_goals"] += 1
-                    goals_skipped += 1
+            pipelined_run = bool(pipe)
+            if pipe:
+                # Inter-goal pipelined path: ONE fused sweep predicts every
+                # goal's satisfaction AND frontier; adjacent unsatisfied
+                # band goals with pairwise-disjoint predicted frontiers
+                # auto-fuse into one stack program; singleton goals run the
+                # frontier driver, which speculatively opens the NEXT
+                # goal's first chunk while its own tail drains
+                # (conflict-gated on device — bit-identical to sequential
+                # stepping).
+                fr_sweep = _get_frontier_sweep_fn(tuple(specs), constraint)
+                env_f = os.environ.get("CRUISE_PIPELINE_FUSE",
+                                       "").strip().lower()
+                if env_f in ("0", "off", "false", "no"):
+                    allow_fuse = False
+                elif env_f in ("1", "on", "force"):
+                    allow_fuse = True
+                else:
+                    # Multi-goal programs at 200-broker shapes kernel-fault
+                    # the tunneled TPU worker (see the chunking comment
+                    # above): the auto-fusion default honors that guard.
+                    allow_fuse = (jax.default_backend() != "tpu"
+                                  or model.num_brokers < 200)
+                # Fused groups run dense without the recorder/segment
+                # plumbing; those modes keep the per-goal driver.
+                allow_fuse = (allow_fuse and use_frontier
+                              and not _flight_recorder()
+                              and segment_steps is None)
+                sat_v = None
+                fronts_v = None
+                sweep_off = False
+                handoff: Optional[dict] = None
+                cross_wasted_total = 0
+                goals_attempted = 0
+                t_goal_end: Optional[float] = None
+
+                def chunk_len_of(sp: GoalSpec) -> int:
+                    return segment_steps or (
+                        32 if (use_frontier and kernels.is_band_kind(sp)
+                               and model.num_brokers > _FRONTIER_DENSE_MIN)
+                        else max(max_steps_per_goal, 1))
+
+                def mk_next(m: int) -> Optional[PipelineNextGoal]:
+                    # Descriptor of the IMMEDIATE successor only: skipping
+                    # a stale-satisfied intermediate goal would need the
+                    # sweep the pipeline is overlapping away, so the
+                    # opener's in-program skip shortcut plays that role.
+                    if m >= len(specs) or fronts_v is None:
+                        return None
+                    sp_n = specs[m]
+                    seed = None
+                    if kernels.is_band_kind(sp_n):
+                        seed = fronts_v[m].copy()
+                        if seed_mask is not None:
+                            seed = seed | seed_mask
+                        if not seed.any():
+                            seed = None
+                    return PipelineNextGoal(
+                        spec=sp_n, prev_specs=tuple(specs[:m]),
+                        seed_active=seed, chunk_len=chunk_len_of(sp_n),
+                        max_steps=max(max_steps_per_goal, 1))
+
+                idx = 0
+                while idx < len(specs):
+                    spec = specs[idx]
+                    tg = time.monotonic()
+                    prev = tuple(specs[:idx])
+                    if handoff is None:
+                        if sat_v is None:
+                            SWEEP_COUNTERS["dispatches"] += 1
+                            sat_np, off_np, fronts_np = jax.device_get(
+                                fr_sweep(model))
+                            sat_v = np.asarray(sat_np)
+                            fronts_v = np.asarray(fronts_np)
+                            sweep_off = bool(off_np)
+                        if bool(sat_v[idx]) and not sweep_off:
+                            SWEEP_COUNTERS["skipped_goals"] += 1
+                            goals_skipped += 1
+                            results.append(GoalResult(
+                                name=spec.name, is_hard=spec.is_hard,
+                                satisfied_before=True, satisfied_after=True,
+                                steps=0, actions_applied=0,
+                                duration_s=time.monotonic() - tg))
+                            idx += 1
+                            continue
+                        # Auto disjoint-frontier fusion: adjacent
+                        # unsatisfied band goals whose predicted frontiers
+                        # share no broker run as ONE chained stack program
+                        # — replacing the manual fuse_group_size knob for
+                        # exactly the groups where in-program chaining
+                        # can't thrash (no broker is revisited).
+                        fuse_specs = (spec,)
+                        if (allow_fuse and not sweep_off
+                                and kernels.is_band_kind(spec)
+                                and fronts_v[idx].any()):
+                            acc = fronts_v[idx].copy()
+                            j = idx + 1
+                            while (len(fuse_specs) < _FUSE_MAX
+                                   and j < len(specs)
+                                   and kernels.is_band_kind(specs[j])
+                                   and not bool(sat_v[j])
+                                   and fronts_v[j].any()
+                                   and not (acc & fronts_v[j]).any()):
+                                acc = acc | fronts_v[j]
+                                fuse_specs = fuse_specs + (specs[j],)
+                                j += 1
+                        if len(fuse_specs) > 1:
+                            n_cached = len(_stack_cache)
+                            stack_fn = _get_stack_fn(
+                                fuse_specs, constraint, ns, nd,
+                                max_steps_per_goal, mesh=mesh,
+                                prev_specs=prev, donate=donate)
+                            miss = len(_stack_cache) > n_cached
+                            token = _persist_token(
+                                "stack", (fuse_specs, constraint, ns, nd,
+                                          max_steps_per_goal, mesh, prev,
+                                          donate), model, options) \
+                                if miss else None
+                            g_fresh = miss and not (
+                                token and compile_cache.seen(token))
+                            model, packed = stack_fn(model, options)
+                            if token:
+                                compile_cache.mark(token)
+                            FETCH_COUNTERS["chunks_dispatched"] += 1
+                            packed_np = np.asarray(jax.device_get(packed))
+                            FETCH_COUNTERS["device_fetches"] += 1
+                            now = time.monotonic()
+                            share = (now - tg) / len(fuse_specs)
+                            for gi, sp_g in enumerate(fuse_specs):
+                                row = packed_np[:, gi]
+                                scored += int(row[0]) * k_of(sp_g)
+                                results.append(GoalResult(
+                                    name=sp_g.name, is_hard=sp_g.is_hard,
+                                    satisfied_before=bool(row[2]),
+                                    satisfied_after=bool(row[3]),
+                                    steps=int(row[0]),
+                                    actions_applied=int(row[1]),
+                                    duration_s=share,
+                                    capped=bool(row[4]),
+                                    fresh_compile=g_fresh,
+                                    repair_steps=int(row[5]),
+                                    bisect_depth=int(row[6]),
+                                    lanes_live=int(row[7]),
+                                    fetches=1 if gi == 0 else 0,
+                                    fused_group=len(fuse_specs)))
+                                _push_repair_sensors(
+                                    sp_g.name, int(row[5]), int(row[6]),
+                                    int(row[7]))
+                                if sp_g.is_hard and not bool(row[3]) \
+                                        and raise_on_hard_failure:
+                                    raise OptimizationFailureException(
+                                        f"hard goal {sp_g.name} not "
+                                        "satisfied after optimization")
+                            goals_fused += len(fuse_specs)
+                            goals_attempted += len(fuse_specs)
+                            if packed_np[1].any():
+                                sat_v = None
+                            t_goal_end = now
+                            idx += len(fuse_specs)
+                            continue
+                    # Singleton per-goal driver, pipelined into the
+                    # immediate successor.  With a handoff in hand the
+                    # first chunk is already in flight — no sweep, no
+                    # dispatch, straight to its fetch.
+                    goals_attempted += 1
+                    model, info = frontier_fixpoint(
+                        model, options, spec, prev, constraint,
+                        num_sources=ns, num_dests=nd,
+                        max_steps=max(max_steps_per_goal, 1),
+                        chunk_steps=chunk_len_of(spec), mesh=mesh,
+                        donate=donate, frontier=use_frontier,
+                        seed_active=seed_mask if handoff is None else None,
+                        next_goal=mk_next(idx + 1), prelaunch=handoff)
+                    adopted = bool(info.get("adopted_prelaunch"))
+                    handoff = info.get("handoff")
+                    if handoff is not None:
+                        goals_overlapped += 1
+                    cross_wasted_total += info.get("cross_wasted", 0)
+                    for ch in info["chunks"]:
+                        scored += ch["steps"] * k_of(spec, ch["ns"],
+                                                     ch["nd"])
+                    if info["actions"]:
+                        sat_v = None  # model changed — sweep re-dispatches
+                    gap = 0.0
+                    if t_goal_end is not None \
+                            and info.get("t_first_dispatch"):
+                        gap = info["t_first_dispatch"] - t_goal_end
                     results.append(GoalResult(
                         name=spec.name, is_hard=spec.is_hard,
-                        satisfied_before=True, satisfied_after=True,
-                        steps=0, actions_applied=0,
-                        duration_s=time.monotonic() - tg))
+                        satisfied_before=info["satisfied_before"],
+                        satisfied_after=info["satisfied_after"],
+                        steps=info["steps"],
+                        actions_applied=info["actions"],
+                        duration_s=time.monotonic() - tg,
+                        capped=info["capped"],
+                        fresh_compile=info["fresh_compile"],
+                        chunks=info["chunks"],
+                        repair_steps=info.get("repair_steps", 0),
+                        bisect_depth=info.get("bisect_depth", 0),
+                        lanes_live=info.get("lanes_live", 0),
+                        fetches=info.get("fetches", 0),
+                        fetch_wait_s=info.get("fetch_wait_s", 0.0),
+                        chunks_speculative=info.get("chunks_speculative",
+                                                    0),
+                        chunks_wasted=info.get("chunks_wasted", 0),
+                        flight=info.get("flight"),
+                        pipelined=adopted,
+                        boundary_gap_s=gap,
+                        chunks_cross_goal=info.get("cross_dispatched", 0),
+                        chunks_cross_wasted=info.get("cross_wasted", 0)))
+                    t_goal_end = time.monotonic()
+                    _push_repair_sensors(spec.name,
+                                         info.get("repair_steps", 0),
+                                         info.get("bisect_depth", 0),
+                                         info.get("lanes_live", 0))
+                    _push_dispatch_sensors(spec.name,
+                                           info.get("fetches", 0),
+                                           info.get("chunks_speculative",
+                                                    0),
+                                           info.get("chunks_wasted", 0))
+                    if info.get("flight") is not None:
+                        _push_flight_sensors(spec.name, info["flight"])
+                    if spec.is_hard and not info["satisfied_after"] \
+                            and raise_on_hard_failure:
+                        raise OptimizationFailureException(
+                            f"hard goal {spec.name} not satisfied after "
+                            "optimization")
+                    idx += 1
+                fill = (goals_overlapped / (goals_attempted - 1)
+                        if goals_attempted > 1 else 0.0)
+                _push_pipeline_sensors(goals_overlapped,
+                                       cross_wasted_total, fill,
+                                       goals_fused)
+            else:
+                sweep_fn = _get_sweep_fn(tuple(specs), constraint)
+                sat_v = None
+                sweep_off = False
+                prev: Tuple[GoalSpec, ...] = ()
+                for spec in specs:
+                    tg = time.monotonic()
+                    i = len(results)
+                    if sat_v is None:
+                        # ONE jitted dispatch answers "already satisfied?"
+                        # for the WHOLE stack; it stays valid until some
+                        # goal mutates the model, then re-dispatches the
+                        # same program (one compile total).
+                        SWEEP_COUNTERS["dispatches"] += 1
+                        sat_np, off_np = jax.device_get(sweep_fn(model))
+                        sat_v = np.asarray(sat_np)
+                        sweep_off = bool(off_np)
+                    if bool(sat_v[i]) and not sweep_off:
+                        # The same decision _goal_fixpoint's skip shortcut
+                        # makes (satisfied + no offline replicas → zero
+                        # steps, before == after), minus the
+                        # fixpoint-program entry.
+                        SWEEP_COUNTERS["skipped_goals"] += 1
+                        goals_skipped += 1
+                        results.append(GoalResult(
+                            name=spec.name, is_hard=spec.is_hard,
+                            satisfied_before=True, satisfied_after=True,
+                            steps=0, actions_applied=0,
+                            duration_s=time.monotonic() - tg))
+                        prev = prev + (spec,)
+                        continue
+                    chunk_len = segment_steps or (
+                        32 if (use_frontier and kernels.is_band_kind(spec)
+                               and model.num_brokers > _FRONTIER_DENSE_MIN)
+                        else max(max_steps_per_goal, 1))
+                    model, info = frontier_fixpoint(
+                        model, options, spec, prev, constraint,
+                        num_sources=ns, num_dests=nd,
+                        max_steps=max(max_steps_per_goal, 1),
+                        chunk_steps=chunk_len, mesh=mesh, donate=donate,
+                        frontier=use_frontier, seed_active=seed_mask)
+                    for ch in info["chunks"]:
+                        scored += ch["steps"] * k_of(spec, ch["ns"],
+                                                     ch["nd"])
+                    if info["actions"]:
+                        sat_v = None  # model changed — sweep re-dispatches
+                    results.append(GoalResult(
+                        name=spec.name, is_hard=spec.is_hard,
+                        satisfied_before=info["satisfied_before"],
+                        satisfied_after=info["satisfied_after"],
+                        steps=info["steps"],
+                        actions_applied=info["actions"],
+                        duration_s=time.monotonic() - tg,
+                        capped=info["capped"],
+                        fresh_compile=info["fresh_compile"],
+                        chunks=info["chunks"],
+                        repair_steps=info.get("repair_steps", 0),
+                        bisect_depth=info.get("bisect_depth", 0),
+                        lanes_live=info.get("lanes_live", 0),
+                        fetches=info.get("fetches", 0),
+                        fetch_wait_s=info.get("fetch_wait_s", 0.0),
+                        chunks_speculative=info.get("chunks_speculative",
+                                                    0),
+                        chunks_wasted=info.get("chunks_wasted", 0),
+                        flight=info.get("flight")))
+                    _push_repair_sensors(spec.name,
+                                         info.get("repair_steps", 0),
+                                         info.get("bisect_depth", 0),
+                                         info.get("lanes_live", 0))
+                    _push_dispatch_sensors(spec.name,
+                                           info.get("fetches", 0),
+                                           info.get("chunks_speculative",
+                                                    0),
+                                           info.get("chunks_wasted", 0))
+                    if info.get("flight") is not None:
+                        _push_flight_sensors(spec.name, info["flight"])
+                    if spec.is_hard and not info["satisfied_after"] \
+                            and raise_on_hard_failure:
+                        raise OptimizationFailureException(
+                            f"hard goal {spec.name} not satisfied after "
+                            "optimization")
                     prev = prev + (spec,)
-                    continue
-                chunk_len = segment_steps or (
-                    32 if (use_frontier and kernels.is_band_kind(spec)
-                           and model.num_brokers > _FRONTIER_DENSE_MIN)
-                    else max(max_steps_per_goal, 1))
-                model, info = frontier_fixpoint(
-                    model, options, spec, prev, constraint,
-                    num_sources=ns, num_dests=nd,
-                    max_steps=max(max_steps_per_goal, 1),
-                    chunk_steps=chunk_len, mesh=mesh, donate=donate,
-                    frontier=use_frontier, seed_active=seed_mask)
-                for ch in info["chunks"]:
-                    scored += ch["steps"] * k_of(spec, ch["ns"], ch["nd"])
-                if info["actions"]:
-                    sat_v = None  # model changed — sweep must re-dispatch
-                results.append(GoalResult(
-                    name=spec.name, is_hard=spec.is_hard,
-                    satisfied_before=info["satisfied_before"],
-                    satisfied_after=info["satisfied_after"],
-                    steps=info["steps"], actions_applied=info["actions"],
-                    duration_s=time.monotonic() - tg,
-                    capped=info["capped"],
-                    fresh_compile=info["fresh_compile"],
-                    chunks=info["chunks"],
-                    repair_steps=info.get("repair_steps", 0),
-                    bisect_depth=info.get("bisect_depth", 0),
-                    lanes_live=info.get("lanes_live", 0),
-                    fetches=info.get("fetches", 0),
-                    fetch_wait_s=info.get("fetch_wait_s", 0.0),
-                    chunks_speculative=info.get("chunks_speculative", 0),
-                    chunks_wasted=info.get("chunks_wasted", 0),
-                    flight=info.get("flight")))
-                _push_repair_sensors(spec.name,
-                                     info.get("repair_steps", 0),
-                                     info.get("bisect_depth", 0),
-                                     info.get("lanes_live", 0))
-                _push_dispatch_sensors(spec.name,
-                                       info.get("fetches", 0),
-                                       info.get("chunks_speculative", 0),
-                                       info.get("chunks_wasted", 0))
-                if info.get("flight") is not None:
-                    _push_flight_sensors(spec.name, info["flight"])
-                if spec.is_hard and not info["satisfied_after"] \
-                        and raise_on_hard_failure:
-                    raise OptimizationFailureException(
-                        f"hard goal {spec.name} not satisfied after "
-                        "optimization")
-                prev = prev + (spec,)
         else:
             packed_rows = []
             # Per-goal flight buffers (i32[G, capacity, FLIGHT_WIDTH] per
@@ -2791,4 +3385,7 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
                         balancedness_after=balancedness_score(
                             costs, [g.name for g in results if not g.satisfied_after]),
                         warm=warm, seed_frontier_size=seed_size,
-                        goals_skipped=goals_skipped)
+                        goals_skipped=goals_skipped,
+                        pipelined=pipelined_run,
+                        goals_overlapped=goals_overlapped,
+                        goals_fused=goals_fused)

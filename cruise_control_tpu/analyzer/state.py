@@ -129,7 +129,38 @@ PACKED_BISECT_DEPTH = 6   # max compiled repair bisection depth
 PACKED_LANES_LIVE = 7     # live candidate lanes at compaction, summed
 PACKED_NUM_ACTIVE = 8     # frontier population at chunk exit; -1 = non-band
 PACKED_ANY_OFFLINE = 9    # offline replicas remain at chunk exit (0/1)
-PACKED_WIDTH = 10
+PACKED_CONFLICT = 10      # brokers touched since the frontier sweep that lie
+                          # inside the NEXT goal's predicted seed frontier; 0
+                          # when the chunk ran without pipeline accounting
+PACKED_WIDTH = 11
+
+
+# ---------------------------------------------------------------------------
+# Inter-goal pipeline invariants
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class PipelineNextGoal:
+    """Host-side descriptor of the NEXT goal in a pipelined stack run.
+
+    The chunk driver (``optimizer.frontier_fixpoint``) uses it to dispatch
+    the next goal's opening chunk while the current goal's convergence tail
+    drains: ``seed_active`` is the next goal's frontier as PREDICTED by the
+    fused stack sweep (computed before the current goal mutated anything),
+    and the on-device conflict slot (``PACKED_CONFLICT`` = |touched ∩
+    seed_active|) invalidates the speculative opener whenever the current
+    goal touched a broker inside that predicted frontier.  ``chunk_len`` /
+    ``max_steps`` / ``min_chunk`` replicate the first-chunk length policy
+    the next goal's own driver would use, so an adopted opener is
+    bit-identical to the chunk a sequential driver would have dispatched.
+    """
+
+    spec: object                        # GoalSpec of the next goal
+    prev_specs: tuple                   # acceptance context it will run under
+    seed_active: Optional[np.ndarray]   # bool[B] predicted frontier (or None)
+    chunk_len: int                      # the next goal's chunk_steps
+    max_steps: int                      # the next goal's step budget
+    min_chunk: int = 4                  # the next goal's min_chunk
 
 
 # ---------------------------------------------------------------------------

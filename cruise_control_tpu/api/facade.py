@@ -385,6 +385,23 @@ class CruiseControl:
         return all(sat.get(g.name, False)
                    for g in crun.goal_results if g.satisfied_after)
 
+    def _absorb_execution(self, run: opt.OptimizerRun, execution) -> None:
+        """Executor completion feeds the standing baseline: once a
+        default-stack plan fully lands, the cluster's placement IS the
+        converged ``run.model``, so the standing entry re-bases onto it —
+        pre-model = converged model, no outstanding proposals — instead of
+        the next tick's delta probe re-discovering the very moves the
+        executor just made (each executed partition showed up as "cluster
+        changed under us" and forced a warm re-solve).  A failed or partial
+        execution absorbs nothing: the placement is then neither the old
+        baseline nor the converged model, and the ordinary delta probe is
+        the honest path."""
+        if execution is None or not getattr(execution, "ok", False):
+            return
+        gen = self.load_monitor.model_generation().as_tuple()
+        with self._cache_lock:
+            self._cached = (gen, time.monotonic(), run.model, run, [])
+
     def _consult_standing(self, model: TensorClusterModel,
                           warm: Optional[bool], ignore_proposal_cache: bool,
                           op: str):
@@ -602,6 +619,7 @@ class CruiseControl:
                         balancedness_scorer=scorer)
                     result.execution = execution
                     result.ok = execution.ok
+                    self._absorb_execution(crun, execution)
                 return result
             if mode == "warm":
                 warm_start = payload
@@ -622,6 +640,8 @@ class CruiseControl:
             result = self._finish(model, run, dryrun, reason, naming,
                                   strategy=strategy,
                                   replication_throttle=replication_throttle)
+        if default_stack and not dryrun and result.ok:
+            self._absorb_execution(run, result.execution)
         return result
 
     @_traced_op

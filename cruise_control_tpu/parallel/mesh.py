@@ -119,7 +119,9 @@ def distributed_frontier_fixpoint(model: TensorClusterModel, spec: GoalSpec,
                                   num_sources: Optional[int] = None,
                                   num_dests: Optional[int] = None,
                                   on_chunk=None, frontier: bool = True,
-                                  speculate: Optional[bool] = None):
+                                  speculate: Optional[bool] = None,
+                                  seed_active=None, next_goal=None,
+                                  prelaunch=None):
     """Shrinking-frontier chunk driver under the device mesh: identical
     orchestration to ``optimizer.frontier_fixpoint`` (boundary stats and
     frontier mask piggybacked on each chunk's packed output, double-buffered
@@ -137,10 +139,25 @@ def distributed_frontier_fixpoint(model: TensorClusterModel, spec: GoalSpec,
     tiny reduction output, not a sharded batch axis) and ``info["flight"]``
     holds the stitched per-step timeline, same as the single-device
     driver: the buffer rides the existing boundary fetch, so the sharded
-    path keeps its ≤1-blocking-fetch-per-boundary budget unchanged."""
+    path keeps its ≤1-blocking-fetch-per-boundary budget unchanged.
+
+    Compacted power-of-two buckets shard over the mesh too: the driver
+    rounds each bucket's candidate widths up to multiples of the mesh size
+    (``optimizer._frontier_widths(..., lanes=mesh.devices.size)``), so the
+    compacted batch divides evenly over the search axis and GSPMD shards
+    it exactly like the dense batch — no device idles on a ragged slice,
+    and the per-bucket executables stay one-per-shape.
+
+    ``seed_active`` warm-seeds the first dispatch's frontier, and
+    ``next_goal`` / ``prelaunch`` (a ``PipelineNextGoal`` descriptor and a
+    handoff record from the previous goal's driver) enable the inter-goal
+    pipelining protocol — all passed through unchanged; the conflict gate
+    and opener dispatches lower through the same GSPMD path as every other
+    chunk."""
     from cruise_control_tpu.analyzer.optimizer import frontier_fixpoint
     return frontier_fixpoint(model, options, spec, prev_specs, constraint,
                              num_sources=num_sources, num_dests=num_dests,
                              max_steps=max_steps, chunk_steps=chunk_steps,
                              mesh=mesh, frontier=frontier, on_chunk=on_chunk,
-                             speculate=speculate)
+                             speculate=speculate, seed_active=seed_active,
+                             next_goal=next_goal, prelaunch=prelaunch)

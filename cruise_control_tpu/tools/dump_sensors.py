@@ -153,6 +153,14 @@ def exercise(api, mgr) -> None:
     model = api.cc.load_monitor.cluster_model()
     proposals = sim.sample_move_proposals(model, moves=2, leadership=1)
     sim.run_simulated_execution(model, proposals, tick_ms=200)
+    # Inter-goal pipelining families: the 5-broker stack sits far below
+    # the auto-pipeline floor, so one explicitly pipelined pass registers
+    # GoalOptimizer.goals-overlapped / goals-fused / pipeline-fill-ratio /
+    # speculative-goal-chunks-wasted.
+    from cruise_control_tpu.analyzer import optimizer as opt
+    opt.optimize(model, ["ReplicaDistributionGoal",
+                         "LeaderReplicaDistributionGoal"],
+                 raise_on_hard_failure=False, fused=True, pipeline=True)
     mgr.run_detectors_once(now_ms=1)
 
 

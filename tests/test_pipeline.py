@@ -1,0 +1,373 @@
+"""Inter-goal pipelining: the fused frontier sweep, auto disjoint-frontier
+fusion, speculative next-goal openers, and the on-device conflict gate.
+
+The protocol's contract is *bit-identity*: overlapping goal N+1's first
+chunk with goal N's tail — and fusing adjacent disjoint-frontier goals into
+one stack program — must never change the converged placement, only the
+wall clock.  Every test here pins some corner of that contract at tier-1
+sizes (B=16, dense floor lowered to 8 so the machinery actually engages
+inside the suite's compile budget); the wall-clock claim itself is the
+bench's --pipeline twin rung.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from cruise_control_tpu.analyzer import optimizer as opt  # noqa: E402
+from cruise_control_tpu.analyzer.balancing_constraint import (  # noqa: E402
+    BalancingConstraint,
+)
+from cruise_control_tpu.analyzer.goals import kernels  # noqa: E402
+from cruise_control_tpu.analyzer.goals.specs import goals_by_priority  # noqa: E402
+from cruise_control_tpu.analyzer.state import (  # noqa: E402
+    PACKED_WIDTH,
+    BrokerArrays,
+    OptimizationOptions,
+    PipelineNextGoal,
+)
+from cruise_control_tpu.model.generator import (  # noqa: E402
+    ClusterSpec,
+    generate_cluster,
+)
+
+STACK = ["RackAwareGoal", "ReplicaDistributionGoal",
+         "LeaderReplicaDistributionGoal"]
+
+
+def _build(seed: int = 7, brokers: int = 16):
+    spec = ClusterSpec(num_brokers=brokers, num_racks=4, num_topics=5,
+                       mean_partitions_per_topic=40.0, replication_factor=2,
+                       distribution="exponential", seed=seed)
+    return generate_cluster(spec)
+
+
+def _skewed_model(seed: int = 7, brokers: int = 16):
+    """One over-band broker (test_frontier.py recipe): a small frontier, so
+    the lowered dense floor engages compaction AND the predicted-frontier
+    seeds of the pipeline have something to say."""
+    model = _build(seed=seed, brokers=brokers)
+    rb = np.asarray(model.replica_broker)
+    rv = np.asarray(model.replica_valid)
+    cnt = np.bincount(rb[rv], minlength=brokers)
+    total = int(cnt.sum())
+    avg, r = total // brokers, total % brokers
+    target = np.full(brokers, avg)
+    target[0] = avg + r
+    pool = [list(np.nonzero(rv & (rb == b))[0]) for b in range(brokers)]
+    moves, dests = [], []
+    for b in range(brokers):
+        moves += [pool[b].pop() for _ in range(max(cnt[b] - target[b], 0))]
+        dests += [b] * max(target[b] - cnt[b], 0)
+    return model.relocate_replicas(jnp.asarray(np.array(moves), jnp.int32),
+                                   jnp.asarray(np.array(dests), jnp.int32),
+                                   jnp.ones(len(moves), bool))
+
+
+def _assert_same_placement(m1, m2):
+    np.testing.assert_array_equal(np.asarray(m1.replica_broker),
+                                  np.asarray(m2.replica_broker))
+    np.testing.assert_array_equal(np.asarray(m1.replica_is_leader),
+                                  np.asarray(m2.replica_is_leader))
+    np.testing.assert_array_equal(np.asarray(m1.replica_disk),
+                                  np.asarray(m2.replica_disk))
+
+
+# ---------------------------------------------------------------------------
+# On-device conflict gate
+# ---------------------------------------------------------------------------
+
+def test_cross_gate_on_device_semantics():
+    """The opener's budget gate collapses to zero unless the predecessor
+    chunk is provably DONE (satisfied, uncapped, nothing offline) and no
+    move landed inside the next goal's seed frontier (PACKED_CONFLICT)."""
+    gate = opt._get_cross_gate_fn()
+
+    def packed(aft, cap, off, conf):
+        p = np.zeros(PACKED_WIDTH, np.int32)
+        p[opt.PACKED_AFTER] = aft
+        p[opt.PACKED_CAPPED] = cap
+        p[opt.PACKED_ANY_OFFLINE] = off
+        p[opt.PACKED_CONFLICT] = conf
+        return jnp.asarray(p)
+
+    assert int(gate(packed(1, 0, 0, 0), jnp.int32(7))) == 7
+    assert int(gate(packed(0, 0, 0, 0), jnp.int32(7))) == 0  # not satisfied
+    assert int(gate(packed(1, 1, 0, 0), jnp.int32(7))) == 0  # capped
+    assert int(gate(packed(1, 0, 1, 0), jnp.int32(7))) == 0  # offline
+    assert int(gate(packed(1, 0, 0, 3), jnp.int32(7))) == 0  # conflict
+
+
+# ---------------------------------------------------------------------------
+# Fused frontier sweep
+# ---------------------------------------------------------------------------
+
+def test_stack_frontiers_sweep_matches_pergoal_kernels():
+    """ONE dispatch answers satisfaction + predicted frontier for the whole
+    stack, and each row must agree with the per-goal kernels it fuses
+    (all-False frontier rows for structural goals)."""
+    model = _skewed_model()
+    con = BalancingConstraint.default()
+    specs = tuple(goals_by_priority(STACK))
+    sat, off, fronts = jax.device_get(
+        opt._get_frontier_sweep_fn(specs, con)(model))
+    sat = np.asarray(sat)
+    fronts = np.asarray(fronts)
+    assert fronts.shape == (len(specs), model.num_brokers)
+    arrays = BrokerArrays.from_model(model)
+    for i, s in enumerate(specs):
+        assert bool(sat[i]) == bool(
+            kernels.goal_satisfied(s, model, arrays, con))
+        if kernels.is_band_kind(s):
+            np.testing.assert_array_equal(
+                fronts[i],
+                np.asarray(kernels.frontier_active(s, model, arrays, con)))
+        else:
+            assert not fronts[i].any()
+    assert not bool(off)
+
+
+# ---------------------------------------------------------------------------
+# Policy knobs
+# ---------------------------------------------------------------------------
+
+def test_pipeline_policy_knobs(monkeypatch):
+    # The policy decision is per-run, not per-goal: a two-goal stack still
+    # exercises every branch (and still has a boundary to overlap) at half
+    # the compile bill of the full tier-1 STACK.
+    stack = STACK[:2]
+    model = _skewed_model()
+    kw = dict(fused=True, raise_on_hard_failure=False)
+    # Tier-1 sizes sit below the dense floor: the auto policy NEVER
+    # pipelines there (the dense program is the same executable either
+    # way), so existing callers are untouched.
+    assert not opt.optimize(model, stack, **kw).pipelined
+    # An explicit manual fuse group is a caller opt-out, even engaged.
+    monkeypatch.setattr(opt, "_FRONTIER_DENSE_MIN", 8)
+    assert not opt.optimize(model, stack, fuse_group_size=1, **kw).pipelined
+    # Above the floor with no manual knob the pipeline is the default...
+    assert opt.optimize(model, stack, **kw).pipelined
+    # ...and CRUISE_PIPELINE=0 is the operator kill-switch.
+    monkeypatch.setenv("CRUISE_PIPELINE", "0")
+    assert not opt.optimize(model, stack, **kw).pipelined
+    monkeypatch.delenv("CRUISE_PIPELINE")
+    # Forcing it clashes with the knobs it replaces.
+    with pytest.raises(ValueError):
+        opt.optimize(model, stack, fuse_group_size=2, pipeline=True, **kw)
+    with pytest.raises(ValueError):
+        opt.optimize(model, stack, pipeline=True,
+                     raise_on_hard_failure=False)
+
+
+# ---------------------------------------------------------------------------
+# Bit-identity: the acceptance bar
+# ---------------------------------------------------------------------------
+
+def test_pipelined_optimize_bit_identical_to_sequential(monkeypatch):
+    """Pipelined stack ≡ sequential stack, bitwise — placement, per-goal
+    steps, and per-goal actions.  Auto-fusion is disabled here so the pin
+    isolates the overlap protocol itself (fusion has its own tests)."""
+    monkeypatch.setattr(opt, "_FRONTIER_DENSE_MIN", 8)
+    monkeypatch.setenv("CRUISE_PIPELINE_FUSE", "0")
+    model = _skewed_model()
+    kw = dict(fused=True, raise_on_hard_failure=False)
+    r_seq = opt.optimize(model, STACK, pipeline=False, **kw)
+    r_pipe = opt.optimize(model, STACK, pipeline=True, **kw)
+    assert not r_seq.pipelined and r_pipe.pipelined
+    _assert_same_placement(r_seq.model, r_pipe.model)
+    assert [(g.name, g.steps, g.actions_applied)
+            for g in r_seq.goal_results] == \
+        [(g.name, g.steps, g.actions_applied)
+         for g in r_pipe.goal_results]
+    # The run actually overlapped goal boundaries, and the opener
+    # accounting closes: every cross-goal chunk is either adopted as a
+    # handoff or counted wasted.
+    assert r_pipe.goals_overlapped >= 1
+    assert any(g.pipelined for g in r_pipe.goal_results)
+    cross = sum(g.chunks_cross_goal for g in r_pipe.goal_results)
+    wasted = sum(g.chunks_cross_wasted for g in r_pipe.goal_results)
+    assert cross == r_pipe.goals_overlapped + wasted
+    # Sequential runs carry no pipeline telemetry.
+    assert all(not g.pipelined and g.chunks_cross_goal == 0
+               for g in r_seq.goal_results)
+
+
+# ---------------------------------------------------------------------------
+# Conflict gate: discard correctness at the driver level
+# ---------------------------------------------------------------------------
+
+def _driver_kw():
+    return dict(num_sources=4, num_dests=1, max_steps=64, chunk_steps=8,
+                min_chunk=1, frontier=True)
+
+
+def test_conflict_gate_discards_speculative_opener(monkeypatch):
+    """A seed frontier that covers the brokers the current goal is moving
+    MUST discard every opener (the moves land inside the next goal's seed,
+    so its compacted first chunk would be stale) — and the discarding
+    driver stays bit-identical to the non-pipelined one."""
+    monkeypatch.setattr(opt, "_FRONTIER_DENSE_MIN", 8)
+    model = _skewed_model()
+    con = BalancingConstraint.default()
+    g1, g2 = goals_by_priority(["ReplicaDistributionGoal",
+                                "LeaderReplicaDistributionGoal"])
+    options = OptimizationOptions.none(model)
+    B = model.num_brokers
+    seed = np.zeros(B, bool)
+    seed[[0, 1, 2, 3]] = True  # broker 0 is the goal's shedder
+    ng = PipelineNextGoal(spec=g2, prev_specs=(g1,), seed_active=seed,
+                          chunk_len=8, max_steps=64)
+    m1, i1 = opt.frontier_fixpoint(model, options, g1, (), con,
+                                   next_goal=ng, **_driver_kw())
+    m0, i0 = opt.frontier_fixpoint(model, options, g1, (), con,
+                                   **_driver_kw())
+    assert i1["actions"] > 0
+    assert i1["cross_dispatched"] >= 1
+    assert i1["cross_wasted"] == i1["cross_dispatched"]
+    assert i1["handoff"] is None
+    # Discarded openers are free: the driver's own trajectory and model
+    # are exactly the non-pipelined ones.
+    assert (i1["steps"], i1["actions"]) == (i0["steps"], i0["actions"])
+    _assert_same_placement(m0, m1)
+
+
+def test_clean_handoff_is_adopted_by_next_driver(monkeypatch):
+    """A seed frontier disjoint from the goal's moves survives the gate:
+    the opener is handed off, the next driver adopts it without a fresh
+    dispatch, and the converged placement equals the cold driver's."""
+    monkeypatch.setattr(opt, "_FRONTIER_DENSE_MIN", 8)
+    model = _skewed_model()
+    con = BalancingConstraint.default()
+    g1, g2 = goals_by_priority(["ReplicaDistributionGoal",
+                                "LeaderReplicaDistributionGoal"])
+    options = OptimizationOptions.none(model)
+    B = model.num_brokers
+    seed = np.zeros(B, bool)
+    seed[[8, 9, 10, 11]] = True  # untouched by the replica-count goal
+    ng = PipelineNextGoal(spec=g2, prev_specs=(g1,), seed_active=seed,
+                          chunk_len=8, max_steps=64)
+    m1, i1 = opt.frontier_fixpoint(model, options, g1, (), con,
+                                   next_goal=ng, **_driver_kw())
+    handoff = i1["handoff"]
+    assert handoff is not None
+    mh, ih = opt.frontier_fixpoint(m1, options, g2, (g1,), con,
+                                   prelaunch=handoff, **_driver_kw())
+    mc, ic = opt.frontier_fixpoint(m1, options, g2, (g1,), con,
+                                   **_driver_kw())
+    assert ih["adopted_prelaunch"] and not ic.get("adopted_prelaunch")
+    assert ih["satisfied_after"] and ic["satisfied_after"]
+    _assert_same_placement(mh, mc)
+
+
+def test_pipelined_chunks_share_one_executable(monkeypatch):
+    """The 6-arg consistent trace: every dense chunk of a pipelined goal —
+    its own chunks, same-goal speculation, the next goal's opener, and the
+    adopting driver's continuation — shares ONE executable per
+    (goal, bucket-widths, fr-structure) shape.  A 4-vs-6-arg mix would
+    double-trace.  num_dests=16 keeps the bucket-8 widths (4x8) distinct
+    from the dense ones (4x16) so every cached fn sees exactly one
+    argument structure; a dense opener (seed None, all-zeros conflict
+    mask) guarantees adoption, making the continuation exercise the
+    opener's own executable."""
+    monkeypatch.setattr(opt, "_FRONTIER_DENSE_MIN", 8)
+    monkeypatch.setattr(opt, "_budget_cache", {})
+    model = _skewed_model()
+    con = BalancingConstraint.default()
+    g1, g2 = goals_by_priority(["ReplicaDistributionGoal",
+                                "LeaderReplicaDistributionGoal"])
+    options = OptimizationOptions.none(model)
+    kw = dict(_driver_kw(), num_dests=16)
+    ng = PipelineNextGoal(spec=g2, prev_specs=(g1,), seed_active=None,
+                          chunk_len=8, max_steps=64)
+    m1, i1 = opt.frontier_fixpoint(model, options, g1, (), con,
+                                   next_goal=ng, **kw)
+    assert i1["cross_dispatched"] >= 1
+    assert i1["handoff"] is not None
+    _, ih = opt.frontier_fixpoint(m1, options, g2, (g1,), con,
+                                  prelaunch=i1["handoff"], **kw)
+    assert ih["adopted_prelaunch"] and ih["satisfied_after"]
+    assert opt._budget_cache, "drivers must have populated the cache"
+    sizes = {k[0].name + f"@{k[3]}x{k[4]}": fn._cache_size()
+             for k, fn in opt._budget_cache.items()}
+    assert all(v == 1 for v in sizes.values()), sizes
+
+
+# ---------------------------------------------------------------------------
+# Auto disjoint-frontier fusion
+# ---------------------------------------------------------------------------
+
+def _canned_sweep(fronts_rows):
+    """A frontier-sweep stand-in with fixed predictions.  Sound to fake:
+    the sweep's output is a performance hint (grouping + opener seeds) —
+    satisfaction and convergence are still decided by the real fused stack
+    program and the real chunk drivers."""
+    fronts = np.asarray(fronts_rows, dtype=bool)
+    sat = np.zeros(len(fronts), dtype=bool)
+
+    def fake_get(specs, constraint):
+        assert len(specs) == len(fronts)
+        return lambda model: (sat, np.False_, fronts)
+
+    return fake_get
+
+
+def test_auto_fusion_groups_disjoint_frontiers(monkeypatch):
+    """Adjacent unsatisfied band goals with broker-disjoint predicted
+    frontiers auto-fuse into ONE chained stack program — the automatic
+    replacement for the manual fuse_group_size knob."""
+    monkeypatch.setattr(opt, "_FRONTIER_DENSE_MIN", 8)
+    model = _skewed_model()
+    B = model.num_brokers
+    f0 = np.zeros(B, bool)
+    f0[[0, 1, 2]] = True
+    f1 = np.zeros(B, bool)
+    f1[[8, 9]] = True
+    monkeypatch.setattr(opt, "_get_frontier_sweep_fn",
+                        _canned_sweep([f0, f1]))
+    goals = ["ReplicaDistributionGoal", "LeaderReplicaDistributionGoal"]
+    run = opt.optimize(model, goals, fused=True, pipeline=True,
+                       raise_on_hard_failure=False)
+    assert run.pipelined
+    assert run.goals_fused == 2
+    assert [g.fused_group for g in run.goal_results] == [2, 2]
+    assert all(g.satisfied_after for g in run.goal_results)
+    con = BalancingConstraint.default()
+    arrays = BrokerArrays.from_model(run.model)
+    for s in goals_by_priority(goals):
+        assert bool(kernels.goal_satisfied(s, run.model, arrays, con))
+    np.testing.assert_array_equal(np.asarray(run.model.replica_valid),
+                                  np.asarray(model.replica_valid))
+
+
+def test_auto_fusion_skips_overlapping_frontiers(monkeypatch):
+    """Frontiers sharing ANY broker must NOT fuse — in-program chaining
+    could revisit that broker, which is exactly the thrash the
+    disjointness test exists to rule out.  The goals fall back to the
+    singleton pipelined drivers and still converge."""
+    monkeypatch.setattr(opt, "_FRONTIER_DENSE_MIN", 8)
+    model = _skewed_model()
+    B = model.num_brokers
+    f0 = np.zeros(B, bool)
+    f0[[0, 1, 2]] = True
+    f1 = np.zeros(B, bool)
+    f1[[2, 8, 9]] = True  # broker 2 collides
+    monkeypatch.setattr(opt, "_get_frontier_sweep_fn",
+                        _canned_sweep([f0, f1]))
+    goals = ["ReplicaDistributionGoal", "LeaderReplicaDistributionGoal"]
+    run = opt.optimize(model, goals, fused=True, pipeline=True,
+                       raise_on_hard_failure=False)
+    assert run.pipelined
+    assert run.goals_fused == 0
+    assert all(g.fused_group == 1 for g in run.goal_results)
+    con = BalancingConstraint.default()
+    arrays = BrokerArrays.from_model(run.model)
+    for s in goals_by_priority(goals):
+        assert bool(kernels.goal_satisfied(s, run.model, arrays, con))

@@ -168,6 +168,34 @@ def test_state_reports_warm_start_block():
         is not None
 
 
+def test_execution_completion_rebases_standing_baseline():
+    """A completed default-stack execution feeds straight back into the
+    standing entry: the delta-probe baseline becomes the converged
+    placement the executor just applied (no outstanding proposals), so the
+    next request is answered without re-solving moves the fleet already
+    made."""
+    cc, lm = build_cc()
+    r = cc.rebalance(dryrun=False)
+    assert r.ok and not r.dryrun
+    assert r.execution is not None and r.execution.ok
+    assert r.proposals, "skewed seed cluster must produce moves"
+    # The absorbed entry IS the execution result: baseline model == the
+    # converged run model (same object — no re-probe, no re-solve), with
+    # an empty outstanding-proposal list.
+    assert cc._cached is not None
+    _gen, _t, pre_model, crun, cprops = cc._cached
+    assert cprops == []
+    assert pre_model is crun.model
+    # Next request: InMemoryClusterAdmin applied the moves to metadata, so
+    # the fresh model is the absorbed baseline — a zero-delta standing hit
+    # with no fixpoint dispatch (device-fetch counters frozen).
+    fetches = dict(opt.FETCH_COUNTERS)
+    r2 = cc.proposals()
+    assert r2.ok and r2.proposals == []
+    assert r2.reason in ("standing", "cached")
+    assert dict(opt.FETCH_COUNTERS) == fetches
+
+
 # ---------------------------------------------------------------------------
 # Optimizer: delta-seeded warm solve
 # ---------------------------------------------------------------------------

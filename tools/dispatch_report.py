@@ -48,6 +48,16 @@ def goal_rows(record: dict) -> list:
             "chunks": len(chunks),
             "chunks_speculative": int(g.get("chunks_speculative", 0)),
             "chunks_wasted": int(g.get("chunks_wasted", 0)),
+            # Inter-goal pipelining economy (PIPELINE_*.json records;
+            # pre-pipeline records read as 0): openers this goal's driver
+            # dispatched into its successor, the subset the conflict gate
+            # discarded, and the signed gap between the PREVIOUS goal's end
+            # and this goal's first dispatch — negative means the dispatch
+            # preceded the boundary, i.e. real overlap.
+            "chunks_cross_goal": int(g.get("chunks_cross_goal", 0)),
+            "chunks_cross_wasted": int(g.get("chunks_cross_wasted", 0)),
+            "boundary_gap_s": float(g.get("boundary_gap_s", 0.0)),
+            "pipelined": bool(g.get("pipelined", False)),
             "fetch_wait_s": float(g.get("fetch_wait_s", 0.0)),
             "wall_s": float(g.get("wall_s", 0.0)),
             "probe_leak": bool(chunks) and fetches > len(chunks),
@@ -64,6 +74,14 @@ def report(record: dict) -> dict:
         "total_fetches": sum(r["fetches"] for r in rows),
         "total_fetch_wait_s": round(sum(r["fetch_wait_s"] for r in rows), 3),
         "total_chunks": sum(r["chunks"] for r in rows),
+        "total_chunks_cross_goal": sum(r["chunks_cross_goal"] for r in rows),
+        "total_chunks_cross_wasted": sum(r["chunks_cross_wasted"]
+                                         for r in rows),
+        # Wall reclaimed by cross-goal overlap: the summed magnitude of the
+        # negative boundary gaps (goals whose first chunk was in flight
+        # before their predecessor finished).
+        "overlap_wall_s": round(-sum(r["boundary_gap_s"] for r in rows
+                                     if r["boundary_gap_s"] < 0), 3),
     }
     if "dispatch" in record:
         out["dispatch"] = record["dispatch"]
@@ -72,9 +90,10 @@ def report(record: dict) -> dict:
 
 def print_table(rep: dict) -> None:
     cols = ("goal", "fetches", "chunks", "chunks_speculative",
-            "chunks_wasted", "fetch_wait_s", "wall_s")
-    head = ("goal", "fetches", "chunks", "spec", "wasted", "boundary_s",
-            "wall_s")
+            "chunks_wasted", "chunks_cross_goal", "chunks_cross_wasted",
+            "boundary_gap_s", "fetch_wait_s", "wall_s")
+    head = ("goal", "fetches", "chunks", "spec", "wasted", "cross",
+            "xwaste", "gap_s", "boundary_s", "wall_s")
     rows = [[str(r[c]) if c == "goal"
              else (f"{r[c]:.3f}" if isinstance(r[c], float) else str(r[c]))
              for c in cols] + (["PROBE-LEAK"] if r["probe_leak"] else [""])
@@ -87,7 +106,10 @@ def print_table(rep: dict) -> None:
               + (f"  {r[-1]}" if r[-1] else ""))
     print(f"total: fetches={rep['total_fetches']} "
           f"chunks={rep['total_chunks']} "
-          f"boundary_wait={rep['total_fetch_wait_s']}s")
+          f"boundary_wait={rep['total_fetch_wait_s']}s "
+          f"cross={rep['total_chunks_cross_goal']} "
+          f"cross_wasted={rep['total_chunks_cross_wasted']} "
+          f"overlap={rep['overlap_wall_s']}s")
     if "dispatch" in rep:
         print(f"dispatch counters: {json.dumps(rep['dispatch'])}")
 
@@ -195,8 +217,12 @@ def main() -> None:
         ap.error("need a bench record path (or --audit)")
     with open(args.record) as f:
         text = f.read().strip()
-    # Accept both a single JSON object and a .jsonl (last line wins).
-    record = json.loads(text.splitlines()[-1])
+    # Accept a pretty-printed artifact (WARM/EXEC/PIPELINE_*.json), a
+    # single JSON line, or a .jsonl (last line wins).
+    try:
+        record = json.loads(text)
+    except ValueError:
+        record = json.loads(text.splitlines()[-1])
     if "per_goal" not in record and "rungs" in record:
         record = record["rungs"][-1]
     rep = report(record)

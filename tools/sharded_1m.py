@@ -118,6 +118,11 @@ def main():
         "num_brokers": 7000,
         "devices": n,
         "backend": devs[0].platform,
+        # The knobs that shaped this capture — a reduced-goal or
+        # reduced-width record must say so instead of passing for a full
+        # 15-goal default run.
+        "goals": goal_names,
+        "ns": ns, "nd": nd, "max_steps": max_steps,
         "optimize_wall_s": round(optimize_wall_s, 1),
         "proposal_diff_s": round(diff_s, 1),
         "total_steps": sum(g["steps"] for g in per_goal.values()),

@@ -81,6 +81,11 @@ def goal_summary(name: str, g: dict, tail_frac: float) -> dict:
         "steps": g.get("steps", 0),
         "actions": g.get("actions", g.get("actions_applied", 0)),
         "wall_s": round(float(g.get("wall_s", 0.0)), 1),
+        # Inter-goal overlap (PIPELINE_*.json records; 0.0 elsewhere):
+        # signed idle gap between the previous goal's end and this goal's
+        # first dispatch — negative means the pipeline had the chunk in
+        # flight before the boundary, so the tail it measures was hidden.
+        "boundary_gap_s": round(float(g.get("boundary_gap_s", 0.0)), 4),
     }
     if chunks:
         rec.update(_chunk_tail(chunks, tail_frac))
@@ -114,6 +119,11 @@ def tail_summary(record: dict, tail_frac: float = 0.1) -> dict:
         "tail_fraction": (round(tail_wall / total_wall, 3)
                           if total_wall > 0 else None),
         "wall_slope": max(slopes) if slopes else None,
+        # Summed magnitude of the negative boundary gaps: wall the
+        # inter-goal pipeline reclaimed by opening goal N+1 while goal N's
+        # tail drained (0.0 for non-pipelined records).
+        "overlap_wall_s": round(-sum(g["boundary_gap_s"] for g in goals
+                                     if g["boundary_gap_s"] < 0), 3),
     }
 
 
@@ -125,28 +135,39 @@ def main(argv: Optional[list] = None) -> None:
     p.add_argument("--json", action="store_true", help="one JSON line only")
     args = p.parse_args(argv)
     with open(args.record) as f:
-        record = json.loads(f.read().strip().splitlines()[0])
+        text = f.read().strip()
+    # Accept a pretty-printed artifact (WARM/EXEC/PIPELINE_*.json), a
+    # single JSON line, or a .jsonl (first line wins).
+    try:
+        record = json.loads(text)
+    except ValueError:
+        record = json.loads(text.splitlines()[0])
     rep = tail_summary(record, args.tail_frac)
     if args.json:
         print(json.dumps(rep), flush=True)
         return
     print(f"{'goal':<40} {'steps':>6} {'actions':>8} {'wall_s':>8} "
-          f"{'chunks':>6} {'tail_s':>8} {'tail%':>6} {'slope':>6}")
+          f"{'chunks':>6} {'tail_s':>8} {'tail%':>6} {'slope':>6} "
+          f"{'gap_s':>8}")
     for g in rep["goals"]:
         tf = (f"{100 * g['tail_fraction']:.0f}%"
               if g["tail_fraction"] is not None else "-")
         sl = (f"{g['wall_slope']:.2f}"
               if g.get("wall_slope") is not None else "-")
+        gap = (f"{g['boundary_gap_s']:+.3f}"
+               if g.get("boundary_gap_s") else "-")
         print(f"{g['goal']:<40} {g['steps']:>6} {g['actions']:>8} "
               f"{g['wall_s']:>8.1f} {g['num_chunks']:>6} "
-              f"{g['tail_wall_s']:>8.1f} {tf:>6} {sl:>6}")
+              f"{g['tail_wall_s']:>8.1f} {tf:>6} {sl:>6} {gap:>8}")
     tf = (f"{100 * rep['tail_fraction']:.0f}%"
           if rep["tail_fraction"] is not None else "-")
     sl = (f"{rep['wall_slope']:.2f}"
           if rep.get("wall_slope") is not None else "-")
+    ov = (f"-{rep['overlap_wall_s']:.3f}"
+          if rep.get("overlap_wall_s") else "-")
     print(f"{'TOTAL (goals with chunk data)':<40} {'':>6} {'':>8} "
           f"{rep['total_wall_s']:>8.1f} {'':>6} {rep['tail_wall_s']:>8.1f} "
-          f"{tf:>6} {sl:>6}")
+          f"{tf:>6} {sl:>6} {ov:>8}")
 
 
 if __name__ == "__main__":
