@@ -774,6 +774,434 @@ def run_pipeline_rung(scale: str, max_candidates, fast: bool) -> dict:
     return rec
 
 
+def run_chaos_rung(scale: str, max_candidates, fast: bool) -> dict:
+    """--chaos: engineered failure scenarios driven end to end through the
+    self-healing pipeline — detector fires → ``model_delta`` probe → warm
+    solve seeded from the standing proposal → executor dispatch — against
+    the simulated fleet (SimulatedClusterAdmin's virtual clock paces the
+    data plane, so time-to-heal is fleet seconds, not host wall).  Each
+    scenario builds a FRESH monitor/facade/detector stack, balances it to a
+    goal-clean baseline, injects one fault, then ticks the detector loop at
+    a 30 s virtual cadence until the anomaly is found and healed.  Writes
+    CHAOS_<rung>.json (tools/chaos_report.py renders it)."""
+    import dataclasses as dc
+
+    from cruise_control_tpu.api.facade import CruiseControl
+    from cruise_control_tpu.common.sensors import SENSORS
+    from cruise_control_tpu.common.tracing import TRACE
+    from cruise_control_tpu.detector.anomalies import AnomalyType
+    from cruise_control_tpu.detector.detectors import (BrokerFailureDetector,
+                                                       DiskFailureDetector,
+                                                       MetricAnomalyDetector)
+    from cruise_control_tpu.detector.device import (DeviceGoalViolationDetector,
+                                                    DeviceMetricAnomalyFinder,
+                                                    DeviceScorer,
+                                                    DeviceSlowBrokerFinder)
+    from cruise_control_tpu.detector.manager import AnomalyDetectorManager
+    from cruise_control_tpu.detector.notifier import SelfHealingNotifier
+    from cruise_control_tpu.executor.admin import SimulatedClusterAdmin
+    from cruise_control_tpu.executor.executor import Executor
+    from cruise_control_tpu.monitor.capacity import StaticCapacityResolver
+    from cruise_control_tpu.monitor.load_monitor import LoadMonitor
+    from cruise_control_tpu.monitor.metadata import (BrokerInfo,
+                                                     ClusterMetadata,
+                                                     MetadataClient,
+                                                     PartitionInfo)
+    from cruise_control_tpu.monitor.sampling import SyntheticWorkloadSampler
+
+    # Chaos-specific fleet shape: the scenario suite pays ~4 full solves per
+    # scenario (baseline + heal, warm + verification), so the replica count
+    # stays CPU-tractable while the broker axis keeps the rung's scale.  At
+    # least 12 brokers / 4 racks so a whole-rack outage leaves rack-aware
+    # rf=3 placement feasible (racks - 1 >= rf).
+    brokers, racks = max(SCALES[scale][0], 12), max(SCALES[scale][1], 4)
+    topics, parts = (12, 32) if brokers >= 50 else (6, 8)
+    window_ms = 300_000
+    tick_ms = 30_000          # detector cadence (anomaly.detection.interval.ms)
+    disk_cap = 20_000.0       # MB; baseline util lands near 35%
+    part_bytes = 100_000_000  # simulated on-disk bytes per partition
+    goals = ["RackAwareGoal", "ReplicaCapacityGoal", "DiskCapacityGoal",
+             "DiskUsageDistributionGoal", "ReplicaDistributionGoal"]
+    hard_goals = goals[:3]
+
+    class _Stack:
+        pass
+
+    def feed(st, sampler=None):
+        """Advance the monitor one metric window (both aggregators)."""
+        t0 = st.window * window_ms
+        st.lm.fetch_once(sampler or st.sampler, t0, t0 + 1)
+        st.window += 1
+
+    def build(detect_goals, capacity=None, demote_score=2):
+        st = _Stack()
+        bs = tuple(BrokerInfo(b, rack=f"r{b % racks}", host=f"h{b}")
+                   for b in range(brokers))
+        ps = []
+        for t in range(topics):
+            for p in range(parts):
+                base = (t * 7 + p * 3) % brokers
+                # Consecutive ids sit on consecutive racks, so rf=3 replica
+                # sets span three racks and rack-aware stays feasible.
+                reps = tuple((base + k) % brokers for k in range(3))
+                ps.append(PartitionInfo(f"t{t}", p, leader=reps[0],
+                                        replicas=reps))
+        st.mc = MetadataClient(ClusterMetadata(brokers=bs,
+                                               partitions=tuple(ps)))
+        st.lm = LoadMonitor(st.mc, capacity
+                            or StaticCapacityResolver(disk=disk_cap),
+                            num_partition_windows=5,
+                            partition_window_ms=window_ms)
+        st.lm.start_up()
+        st.sampler = SyntheticWorkloadSampler()
+        st.window = 0
+        for _ in range(6):
+            feed(st)
+        st.admin = SimulatedClusterAdmin(
+            st.mc, {(f"t{t}", p): part_bytes
+                    for t in range(topics) for p in range(parts)},
+            tick_ms=1000, rate_bytes_per_sec=200_000_000.0)
+        st.ex = Executor(st.admin, st.mc, clock_ms=st.admin.now_ms,
+                         concurrency_adjuster_interval_ms=0)
+        st.cc = CruiseControl(st.lm, st.ex, st.admin, goals=goals,
+                              hard_goals=hard_goals,
+                              warm_start_enabled=True,
+                              warm_start_delta_threshold=1.0,
+                              max_candidates_per_step=max_candidates)
+        notifier = SelfHealingNotifier(
+            self_healing_enabled=dict.fromkeys(AnomalyType, True),
+            broker_failure_alert_threshold_ms=0,
+            broker_failure_self_healing_threshold_ms=0)
+        st.mgr = AnomalyDetectorManager(
+            notifier, st.cc,
+            executor_busy=lambda: st.ex.has_ongoing_execution)
+        scorer = DeviceScorer()
+        st.bf = BrokerFailureDetector(st.mc)
+        st.mgr.register_detector(
+            DeviceGoalViolationDetector(st.lm, detect_goals), tick_ms)
+        st.mgr.register_detector(st.bf, tick_ms)
+        st.mgr.register_detector(DiskFailureDetector(st.admin, st.mc), tick_ms)
+        st.mgr.register_detector(
+            MetricAnomalyDetector(st.lm, [
+                DeviceSlowBrokerFinder(demote_score=demote_score,
+                                       scorer=scorer),
+                DeviceMetricAnomalyFinder(scorer=scorer)]), tick_ms)
+        # Balance to a goal-clean baseline; the successful execution re-bases
+        # the standing proposal onto the executed placement, which is exactly
+        # what the heal pipeline's warm seed consults.
+        st.baseline_ok = bool(st.cc.rebalance(dryrun=False,
+                                              reason="chaos-baseline").ok)
+        st.now = 0
+        st.baseline_found = st.mgr.run_detectors_once(st.now)
+        st.mgr.handle_anomalies_once(st.now)
+        return st
+
+    def kill(st, victims):
+        cluster = st.mc.cluster()
+        dead = set(victims)
+        st.mc.refresh(dc.replace(cluster, brokers=tuple(
+            dc.replace(b, is_alive=b.broker_id not in dead)
+            for b in cluster.brokers)))
+
+    _HEAL_OPS = ("rebalance", "remove_brokers", "demote_brokers",
+                 "fix_offline_replicas")
+
+    def heal_counts():
+        out = {}
+        for name in ("heal-warm-solves", "heal-cold-solves",
+                     "warm-fallbacks"):
+            for op in _HEAL_OPS:
+                out[f"{name}:{op}"] = SENSORS.counter(
+                    f"CruiseControl.{name}", labels={"op": op}).count
+        out["heals-started"] = SENSORS.counter(
+            "AnomalyDetector.heals-started").count
+        out["heals-failed"] = SENSORS.counter(
+            "AnomalyDetector.heals-failed").count
+        return out
+
+    def heal_flight():
+        """Flight-recorder evidence off the heal trace: per-goal step counts
+        from the ``analyzer.goal`` spans nested under ``detector.heal``."""
+        for root in TRACE.recent(32):  # newest-first: first hit = this heal
+            if root.get("name") != "detector.heal":
+                continue
+            out = []
+            stack = list(root.get("children") or [])
+            while stack:
+                sp = stack.pop()
+                stack.extend(sp.get("children") or [])
+                attrs = sp.get("attrs") or {}
+                if sp.get("name") == "analyzer.goal" and "flight" in attrs:
+                    fl = attrs["flight"]
+                    steps = (fl.get("steps") if isinstance(fl, dict)
+                             else fl if isinstance(fl, (list, tuple))
+                             else None)
+                    out.append({"goal": attrs.get("goal"),
+                                "steps": attrs.get("steps"),
+                                "flight_steps": len(steps)
+                                if steps is not None else None})
+            return out or None
+        return None
+
+    # -- the scenario suite -------------------------------------------------
+    n_kill = 5 if brokers >= 25 else 2
+    spread = sorted({(1 + i * (brokers // n_kill + 1)) % brokers
+                     for i in range(n_kill)})
+    rack_victims = [b for b in range(brokers) if b % racks == 3 % racks]
+    det_all = ["RackAwareGoal", "DiskCapacityGoal",
+               "DiskUsageDistributionGoal"]
+    det_cap = ["RackAwareGoal", "DiskCapacityGoal"]
+
+    class _TieredCapacity(StaticCapacityResolver):
+        """Half the fleet shrinks to small disks; the other half keeps the
+        headroom the heal needs."""
+
+        def __init__(self, small_ids, small_disk):
+            super().__init__(disk=disk_cap)
+            self._small_ids = frozenset(small_ids)
+            self._small_disk = small_disk
+
+        def capacity_for_broker(self, rack, host, broker_id,
+                                allow_estimation=True):
+            info = super().capacity_for_broker(rack, host, broker_id,
+                                               allow_estimation)
+            if broker_id in self._small_ids:
+                info = dc.replace(info, disk=self._small_disk)
+            return info
+
+    class _SkewSampler(SyntheticWorkloadSampler):
+        """Hot-keyspace workload: the first quarter of t0's partitions run
+        ``factor`` hot.  Skewing a *subset* keeps the imbalance structural —
+        t0's replicas land uniformly (the synthetic placement interleaves
+        racks), so a uniform all-of-t0 skew would load every broker equally
+        and whether the distribution goal trips would ride on the sampler's
+        per-process random partition scales."""
+
+        def __init__(self, factor, parts):
+            super().__init__()
+            self._factor = factor
+            self._hot = max(1, parts // 4)
+
+        def get_samples(self, cluster, partitions, start_ms, end_ms,
+                        mode=None):
+            samples = (super().get_samples(cluster, partitions, start_ms,
+                                           end_ms, mode) if mode is not None
+                       else super().get_samples(cluster, partitions,
+                                                start_ms, end_ms))
+            for s in samples.partition_samples:
+                if s.topic == "t0" and s.partition < self._hot:
+                    for k in s.metrics:
+                        s.metrics[k] *= self._factor
+            return samples
+
+    class _SlowSampler(SyntheticWorkloadSampler):
+        """One broker's log-flush 999th spikes far past its history."""
+
+        def __init__(self, victim, flush_ms=400.0):
+            super().__init__()
+            self._victim = victim
+            self._flush = flush_ms
+
+        def get_samples(self, cluster, partitions, start_ms, end_ms,
+                        mode=None):
+            samples = (super().get_samples(cluster, partitions, start_ms,
+                                           end_ms, mode) if mode is not None
+                       else super().get_samples(cluster, partitions,
+                                                start_ms, end_ms))
+            for s in samples.broker_samples:
+                if s.broker_id == self._victim:
+                    s.metrics["BROKER_LOG_FLUSH_TIME_MS_999TH"] = self._flush
+            return samples
+
+    def inject_mass_death(st):
+        kill(st, spread)
+        return {"killed_brokers": spread}
+
+    def inject_rack_outage(st):
+        kill(st, rack_victims)
+        return {"killed_brokers": rack_victims,
+                "rack": f"r{3 % racks}"}
+
+    def inject_disk_failure(st):
+        victim = 7 % brokers
+        st.admin.logdir_health[victim] = {"/kafka-logs": False}
+        cluster = st.mc.cluster()
+        st.mc.refresh(dc.replace(cluster, partitions=tuple(
+            dc.replace(p, offline_replicas=(victim,))
+            if victim in p.replicas else p
+            for p in cluster.partitions)))
+        return {"victim": victim}
+
+    def inject_hetero_capacity(st):
+        # Shrink half the fleet's disks to ~110% of their current usage, so
+        # the 80% capacity threshold trips without making the heal (packing
+        # onto the untouched half) infeasible.
+        small = list(range(brokers // 2))
+        per_broker_mb = topics * parts * 3 * 100.0 / brokers
+        small_disk = round(per_broker_mb / 0.9)
+        st.lm._capacity = _TieredCapacity(small, small_disk=small_disk)
+        return {"small_brokers": len(small), "small_disk_mb": small_disk}
+
+    def inject_hot_topic(st):
+        for _ in range(2):
+            feed(st, _SkewSampler(25.0, parts))
+        return {"topic": "t0", "hot_partitions": max(1, parts // 4),
+                "factor": 25.0}
+
+    def inject_slow_broker(st):
+        st.slow = _SlowSampler(11 % brokers)
+        feed(st, st.slow)
+        return {"victim": 11 % brokers}
+
+    def tick_slow_broker(st):
+        feed(st, st.slow)
+
+    def ack_death(st, info):
+        # Operator acknowledgment: once the heal moved every replica off the
+        # dead brokers they are decommissioned — dropped from the failure
+        # detector's ledger AND from the metadata (a still-listed dead
+        # broker would legitimately re-alert on every later tick).
+        dead = set(info["killed_brokers"])
+        st.bf.forget(info["killed_brokers"])
+        cluster = st.mc.cluster()
+        st.mc.refresh(dc.replace(cluster, brokers=tuple(
+            b for b in cluster.brokers if b.broker_id not in dead)))
+
+    def ack_disk(st, info):
+        st.admin.logdir_health[info["victim"]] = {"/kafka-logs": True}
+
+    def ack_slow(st, info):
+        feed(st)  # demoted broker's flush recovers in the next window
+
+    def ack_skew(st, info):
+        # The heal spread the hot topic's replicas; the skew itself is a
+        # transient workload burst, so post-heal windows sample at normal
+        # rates and the skewed windows age out of the monitor's history.
+        for _ in range(5):
+            feed(st)
+
+    scenarios = [
+        # (name, detection goals, inject, per-tick hook, post-heal ack).
+        # Failure scenarios detect on the capacity goals only: a broker/disk
+        # heal relocates replicas without re-levelling usage distribution,
+        # and a distribution violation on the survivors would mask the
+        # question this suite asks ("is the FAULT healed?").  The workload
+        # scenarios (hot topic) detect on the distribution goal — there the
+        # skew IS the anomaly.
+        ("mass_broker_death", det_cap, inject_mass_death, None, ack_death),
+        ("rack_outage", det_cap, inject_rack_outage, None, ack_death),
+        ("disk_failure", det_cap, inject_disk_failure, None, ack_disk),
+        ("heterogeneous_capacity", det_cap, inject_hetero_capacity, None,
+         None),
+        ("hot_topic_skew", det_all, inject_hot_topic, None, ack_skew),
+        ("slow_broker", det_cap, inject_slow_broker, tick_slow_broker,
+         ack_slow),
+    ]
+
+    records = []
+    for name, det_goals, inject, per_tick, ack in scenarios:
+        st = build(det_goals)
+        bal_before = st.mgr.balancedness_score()
+        info = inject(st)
+        detected_tick = None
+        for tick in range(1, 11):
+            if per_tick is not None:
+                per_tick(st)
+            st.now += tick_ms
+            if st.mgr.run_detectors_once(st.now):
+                detected_tick = tick
+                break
+        rec = {"scenario": name, "inject": info,
+               "baseline_ok": st.baseline_ok,
+               "baseline_anomalies": st.baseline_found,
+               "balancedness_before": bal_before,
+               "detected": detected_tick is not None,
+               "time_to_detect_s": (detected_tick or 0) * tick_ms / 1000.0
+               if detected_tick else None}
+        if detected_tick is not None:
+            anomaly_types = sorted(
+                {t.name for t in AnomalyType
+                 for s in st.mgr.state.recent(t)
+                 if s.status == "DETECTED"})
+            bal_detected = st.mgr.balancedness_score()
+            c0, fleet0 = heal_counts(), st.admin.now_ms()
+            t0 = time.monotonic()
+            st.mgr.handle_anomalies_once(st.now)
+            heal_host_s = time.monotonic() - t0
+            fleet_heal_s = (st.admin.now_ms() - fleet0) / 1000.0
+            c1 = heal_counts()
+            delta = {k: c1[k] - c0[k] for k in c1 if c1[k] != c0[k]}
+            statuses = [s.status for t in AnomalyType
+                        for s in st.mgr.state.recent(t)]
+            flight = heal_flight()
+            if ack is not None:
+                ack(st, info)
+            # Post-heal convergence: a heal fixes the FAULT in one dispatch,
+            # but a secondary violation (e.g. usage distribution on the
+            # survivors) may legitimately need another detect→heal round —
+            # tick until clean, bounded.
+            rounds, post_found = 1, None
+            for _ in range(3):
+                st.now += tick_ms
+                post_found = st.mgr.run_detectors_once(st.now)
+                if not post_found:
+                    break
+                st.mgr.handle_anomalies_once(st.now)
+                rounds += 1
+            rec.update({
+                "anomaly_types": anomaly_types,
+                "healed": delta.get("heals-started", 0) > 0
+                or "FIX_STARTED" in statuses,
+                "time_to_heal_s": round(fleet_heal_s + heal_host_s, 3),
+                "fleet_transfer_s": round(fleet_heal_s, 3),
+                "heal_solve_host_s": round(heal_host_s, 3),
+                "warm": any(k.startswith("heal-warm-solves") for k in delta),
+                "heal_counters": delta,
+                "heal_rounds": rounds,
+                "post_clean": post_found == 0,
+                "balancedness_detected": bal_detected,
+                "balancedness_after": st.mgr.balancedness_score(),
+                "flight": flight,
+            })
+        records.append(rec)
+        sys.stderr.write(json.dumps({"chaos_scenario": name,
+                                     "detected": rec["detected"],
+                                     "healed": rec.get("healed", False)})
+                         + "\n")
+        sys.stderr.flush()
+
+    healed = [r for r in records if r.get("healed")]
+    heal_times = [r["time_to_heal_s"] for r in healed]
+    rec = {
+        "metric": f"chaos_time_to_heal_{scale}",
+        "value": round(max(heal_times), 3) if heal_times else -1.0,
+        "unit": "s",
+        # No recorded chaos baseline yet — this artifact IS the yardstick
+        # future detect/heal work is judged against.
+        "vs_baseline": 1.0,
+        "num_brokers": brokers,
+        "num_replicas": topics * parts * 3,
+        "detection_interval_s": tick_ms / 1000.0,
+        "scenarios_total": len(records),
+        "scenarios_detected": sum(1 for r in records if r["detected"]),
+        "scenarios_healed": len(healed),
+        "scenarios_warm_healed": sum(1 for r in healed if r.get("warm")),
+        "time_to_heal_max_s": round(max(heal_times), 3) if heal_times
+        else None,
+        "time_to_heal_mean_s": round(sum(heal_times) / len(heal_times), 3)
+        if heal_times else None,
+        "scenarios": records,
+        **({"fast_mode": True} if fast else {}),
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        f"CHAOS_{scale}.json")
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1, sort_keys=True)
+        f.write("\n")
+    rec["chaos_artifact"] = os.path.basename(path)
+    return rec
+
+
 def main() -> None:
     # Rung selection: --rungs flag > BENCH_SCALE env > default small,mid.
     # The default deliberately stops at mid (~10k replicas): it is the
@@ -814,12 +1242,21 @@ def main() -> None:
                          "equisatisfaction and verifier enforced in-rung), "
                          "write PIPELINE_<rung>.json with the compile-"
                          "ceiling probe (default rung: mid)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="run the chaos-fleet rung(s) instead: engineered "
+                         "failure scenarios (broker death, rack outage, disk "
+                         "failure, capacity skew, hot topic, slow broker) "
+                         "driven through the detect→heal pipeline against "
+                         "the simulated fleet, write CHAOS_<rung>.json "
+                         "(default rung: mid)")
     args = ap.parse_args()
-    if args.flight or args.warm:
+    if args.flight or args.warm or args.chaos:
         # --warm always records flight telemetry: the WARM artifact's whole
-        # point is the cold-vs-warm convergence overlay.
+        # point is the cold-vs-warm convergence overlay.  --chaos records it
+        # so every heal solve's convergence rides the detector.heal trace.
         os.environ["CRUISE_FLIGHT_RECORDER"] = "1"
-    default_rungs = ("mid" if (args.execute or args.warm or args.pipeline)
+    default_rungs = ("mid" if (args.execute or args.warm or args.pipeline
+                               or args.chaos)
                      else "small,mid")
     scale_sel = args.rungs or os.environ.get("BENCH_SCALE") or default_rungs
     scales = (["small", "mid", "large"] if scale_sel == "ladder"
@@ -859,12 +1296,14 @@ def main() -> None:
         metric = ("execution_wall_to_balanced_small" if args.execute
                   else "warm_vs_cold_speedup_small" if args.warm
                   else "pipeline_stack_speedup_small" if args.pipeline
+                  else "chaos_time_to_heal_small" if args.chaos
                   else "wall_clock_to_goal_satisfying_proposal_small")
         _record_rung({"metric": metric, "value": 0.0, "unit": "s",
                       "vs_baseline": 0.0, "selftest": True,
                       **({"execute": True} if args.execute else {}),
                       **({"warm": True} if args.warm else {}),
-                      **({"pipeline": True} if args.pipeline else {})})
+                      **({"pipeline": True} if args.pipeline else {}),
+                      **({"chaos": True} if args.chaos else {})})
         while True:
             signal.pause()
 
@@ -885,6 +1324,7 @@ def main() -> None:
                else run_warm_rung(s, max_candidates, fast) if args.warm
                else run_pipeline_rung(s, max_candidates, fast)
                if args.pipeline
+               else run_chaos_rung(s, max_candidates, fast) if args.chaos
                else run_rung(s, max_candidates, fast))
         cancel()
         rec["backend"] = platform

@@ -455,6 +455,57 @@ class CruiseControl:
                                      active_mask=active)
         return "cold", None
 
+    def _heal_warm_start(self, model: TensorClusterModel,
+                         options: OptimizationOptions,
+                         op: str) -> Optional[WarmStart]:
+        """Seed a self-healing solve from the standing proposal.
+
+        A detected anomaly mutates a small part of the fleet, so a heal is
+        exactly the small-delta warm case cruise mode already handles: diff
+        the wounded model against the standing CONVERGED placement and seed
+        the fixpoint from it.  Every dead/demoted broker force-joins the
+        seed frontier — ``model_delta``'s state clause only catches state
+        *changes*, but a broker that was already dead when the standing
+        entry was built must still be live optimization surface (its
+        offline replicas are the heal's whole point).  No delta-magnitude
+        gate: the dense confirm chunk validates convergence, so an
+        oversized delta costs steps, never correctness.  Falls cold when
+        self-heal exclusions are active — the standing placement predates
+        them and could seed moves onto excluded brokers."""
+        labels = {"op": op}
+        warms = SENSORS.counter(
+            "CruiseControl.heal-warm-solves", labels=labels,
+            help="Self-healing solves seeded warm from the standing "
+                 "proposal's converged placement")
+        colds = SENSORS.counter(
+            "CruiseControl.heal-cold-solves", labels=labels,
+            help="Self-healing solves that ran cold (no standing entry, "
+                 "membership drift, warm start disabled, or active "
+                 "self-heal exclusions)")
+        excluded = bool(
+            np.asarray(options.broker_excluded_replica_move).any()
+            or np.asarray(options.broker_excluded_leadership).any())
+        if not self._warm_start_enabled or excluded:
+            colds.inc(1)
+            return None
+        with self._cache_lock:
+            standing = self._cached
+        if standing is None:
+            colds.inc(1)
+            return None
+        crun = standing[3]
+        delta = model_delta(crun.model, model)
+        if delta is None:
+            colds.inc(1)
+            return None  # membership/shape drift: warm unsound
+        active = delta.changed_mask.copy()
+        active |= ((np.asarray(model.broker_state) != BrokerState.ALIVE)
+                   & np.asarray(model.broker_valid))
+        warms.inc(1)
+        TRACE.annotate(heal_warm=True,
+                       heal_seed_frontier=int(active.sum()))
+        return WarmStart(prev_model=crun.model, active_mask=active)
+
     @staticmethod
     def _standing_result(crun: opt.OptimizerRun,
                          cprops: List[props.ExecutionProposal],
@@ -586,8 +637,12 @@ class CruiseControl:
             tmask = np.array(options.topic_excluded)
             tmask[list(excluded_topics)] = True
             options = options.replace(topic_excluded=jnp.asarray(tmask))
+        warm_start = None
         if self_healing:
             options = self._self_heal_excludes(options, naming)
+            # Heal pipeline: detector fired → delta probe → warm solve
+            # seeded from the standing converged placement.
+            warm_start = self._heal_warm_start(model, options, "rebalance")
         if rebalance_disk and goals is None:
             # rebalance_disk=true runs the intra-broker (JBOD) stack
             # (intra.broker.goals) instead of the inter-broker default.
@@ -599,7 +654,6 @@ class CruiseControl:
                          and not excluded_topics and not rebalance_disk
                          and not self_healing and not excluded_topics_pattern
                          and not fast_mode)
-        warm_start = None
         if default_stack:
             mode, payload = self._consult_standing(model, warm, False,
                                                    "rebalance")
@@ -676,12 +730,28 @@ class CruiseControl:
             model = model.set_broker_state(b, BrokerState.DEAD)
         strategy = self._request_strategy(replica_movement_strategies)
         options = self._base_options(model, naming, excluded_topics_pattern)
+        warm_start = None
         if self_healing:
             options = self._self_heal_excludes(options, naming)
-        run = self._optimize(model, self.goals, options)
+            warm_start = self._heal_warm_start(model, options,
+                                               "remove_brokers")
+        run = self._optimize(model, self.goals, options,
+                             warm_start=warm_start)
         result = self._finish(model, run, dryrun, reason, naming,
                               strategy=strategy,
                               replication_throttle=replication_throttle)
+        if warm_start is not None and not result.ok \
+                and result.execution is None:
+            # Warm heal failed verification: cold fallback.
+            SENSORS.counter(
+                "CruiseControl.warm-fallbacks",
+                labels={"op": "remove_brokers"},
+                help="Warm solves that failed verification and fell back "
+                     "to a cold solve").inc(1)
+            run = self._optimize(model, self.goals, options)
+            result = self._finish(model, run, dryrun, reason, naming,
+                                  strategy=strategy,
+                                  replication_throttle=replication_throttle)
         if result.ok and not dryrun:
             self.executor.add_recently_removed_brokers(list(broker_ids))
         return result.ok
@@ -747,10 +817,24 @@ class CruiseControl:
         (FixOfflineReplicasRunnable)."""
         model, naming = self._model_naming()
         options = self._base_options(model, naming)
+        warm_start = None
         if self_healing:
             options = self._self_heal_excludes(options, naming)
-        run = self._optimize(model, self.hard_goals, options)
-        return self._finish(model, run, dryrun, reason, naming).ok
+            warm_start = self._heal_warm_start(model, options,
+                                               "fix_offline_replicas")
+        run = self._optimize(model, self.hard_goals, options,
+                             warm_start=warm_start)
+        result = self._finish(model, run, dryrun, reason, naming)
+        if warm_start is not None and not result.ok \
+                and result.execution is None:
+            SENSORS.counter(
+                "CruiseControl.warm-fallbacks",
+                labels={"op": "fix_offline_replicas"},
+                help="Warm solves that failed verification and fell back "
+                     "to a cold solve").inc(1)
+            run = self._optimize(model, self.hard_goals, options)
+            result = self._finish(model, run, dryrun, reason, naming)
+        return result.ok
 
     @_traced_op
     def update_topic_replication_factor(self, topics_rf: Dict[str, int],

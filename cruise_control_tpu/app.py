@@ -271,8 +271,17 @@ class KafkaCruiseControlApp:
             executor_busy=lambda: self.executor.has_ongoing_execution,
             history_size=cfg.get(C.NUM_CACHED_RECENT_ANOMALY_STATES_CONFIG))
         interval = cfg.get(C.ANOMALY_DETECTION_INTERVAL_MS_CONFIG)
+        # anomaly.detector.device.scoring: detect on-device — goal violations
+        # through the fused stack-satisfied sweep, metric/slow-broker scoring
+        # as one batched program per tick (detector/device.py).
+        device_scoring = cfg.get(C.ANOMALY_DETECTOR_DEVICE_SCORING_CONFIG)
+        goal_violation_cls = GoalViolationDetector
+        if device_scoring:
+            from cruise_control_tpu.detector.device import \
+                DeviceGoalViolationDetector
+            goal_violation_cls = DeviceGoalViolationDetector
         self.detector_manager.register_detector(
-            GoalViolationDetector(self.load_monitor,
+            goal_violation_cls(self.load_monitor,
                                   cfg.get(C.ANOMALY_DETECTION_GOALS_CONFIG),
                                   provisioner=provisioner,
                                   balancedness_priority_weight=cfg.get(
@@ -287,6 +296,24 @@ class KafkaCruiseControlApp:
         # metric.anomaly.finder.class (slow-broker detection by default).
         finders = cfg.get_configured_instances(
             C.METRIC_ANOMALY_FINDER_CLASSES_CONFIG, object)
+        if device_scoring and finders:
+            # Swap stock scalar finders for their batched device twins — one
+            # shared scorer, so both families share one scoring dispatch per
+            # tick.  Custom plugin classes stay as configured.
+            from cruise_control_tpu.detector.detectors import (
+                PercentileMetricAnomalyFinder, SlowBrokerFinder)
+            from cruise_control_tpu.detector.device import (
+                DeviceMetricAnomalyFinder, DeviceScorer, DeviceSlowBrokerFinder)
+            twins = {SlowBrokerFinder: DeviceSlowBrokerFinder,
+                     PercentileMetricAnomalyFinder: DeviceMetricAnomalyFinder}
+            scorer = DeviceScorer()
+            merged = cfg.merged_values()
+            for i, finder in enumerate(finders):
+                twin_cls = twins.get(type(finder))
+                if twin_cls is not None:
+                    twin = twin_cls(scorer=scorer)
+                    twin.configure(merged)
+                    finders[i] = twin
         if finders:
             self.detector_manager.register_detector(
                 MetricAnomalyDetector(self.load_monitor, finders), interval)

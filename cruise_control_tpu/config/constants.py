@@ -396,6 +396,7 @@ METRIC_ANOMALY_UPPER_MARGIN_CONFIG = "metric.anomaly.upper.margin"
 SELF_HEALING_TARGET_TOPIC_REPLICATION_FACTOR_CONFIG = "self.healing.target.topic.replication.factor"
 PROVISIONER_CLASS_CONFIG = "provisioner.class"
 NUM_CACHED_RECENT_ANOMALY_STATES_CONFIG = "num.cached.recent.anomaly.states"
+ANOMALY_DETECTOR_DEVICE_SCORING_CONFIG = "anomaly.detector.device.scoring"
 
 
 def anomaly_detector_config_def() -> ConfigDef:
@@ -467,6 +468,12 @@ def anomaly_detector_config_def() -> ConfigDef:
              importance=Importance.LOW, doc="Provisioner (rightsizing) plugin.", group="detector")
     d.define(NUM_CACHED_RECENT_ANOMALY_STATES_CONFIG, Type.INT, 10, Range.between(1, 100),
              Importance.LOW, doc="Ring-buffer size of recent anomalies per type.", group="detector")
+    d.define(ANOMALY_DETECTOR_DEVICE_SCORING_CONFIG, Type.BOOLEAN, True,
+             importance=Importance.MEDIUM,
+             doc="Score anomalies on-device: goal violations through the fused "
+                 "stack-satisfied sweep and metric/slow-broker finders as one "
+                 "batched program per tick (detector/device.py).  Off falls "
+                 "back to the scalar host detectors.", group="detector")
     return d
 
 

@@ -116,8 +116,13 @@ class GoalViolations(Anomaly):
     def fix(self, context) -> bool:
         if not self.fixable_goals:
             return False
-        return context.rebalance(goals=self.fixable_goals, reason=self.reason(),
-                                 self_healing=True)
+        # Heal with the FULL configured stack, not just the violated goals:
+        # a solve constrained only by the violated goal is free to break the
+        # rest of the stack (e.g. a DiskCapacityGoal-only fix un-racks
+        # replicas), turning one violation into a detect→fix flap.  The
+        # reference's GOAL_VIOLATION self-healing likewise runs the
+        # configured self-healing goals, which default to the whole stack.
+        return context.rebalance(reason=self.reason(), self_healing=True)
 
 
 @dataclasses.dataclass

@@ -76,6 +76,23 @@ class GoalViolationDetector:
             if self._goals else {})  # empty detection set = detector disabled
         self.balancedness_score: float = MAX_BALANCEDNESS_SCORE
 
+    def _goal_satisfactions(self, model):
+        """Per-goal satisfied flags plus the any-offline-replica verdict.
+
+        The scalar path costs one device round-trip per goal; the device
+        subclass (``detector.device.DeviceGoalViolationDetector``) answers
+        both questions in ONE fused stack-satisfied sweep dispatch.  Returns
+        ``(sat, any_offline)`` where ``sat`` is a list of bools in
+        ``goals_by_priority`` order (None when offline replicas exist — the
+        caller defers to the failure detectors without evaluating goals)."""
+        if bool(np.asarray(model.replica_offline_now()).any()):
+            return None, True
+        arrays = BrokerArrays.from_model(model)
+        sat = [bool(kernels.goal_satisfied(spec, model, arrays,
+                                           self._constraint))
+               for spec in goals_by_priority(self._goals)]
+        return sat, False
+
     def detect(self, now_ms: int) -> Optional[GoalViolations]:
         from cruise_control_tpu.analyzer.balancedness import (
             BALANCEDNESS_SCORE_WITH_OFFLINE_REPLICAS, balancedness_score)
@@ -83,7 +100,8 @@ class GoalViolationDetector:
             model = self._lm.cluster_model()
         except NotEnoughValidWindowsError:
             return None
-        if bool(np.asarray(model.replica_offline_now()).any()):
+        sat, any_offline = self._goal_satisfactions(model)
+        if any_offline:
             # Defer to broker/disk failure detectors (GoalViolationDetector
             # skips when offline replicas exist, :160-237); the score is
             # pinned to the offline sentinel meanwhile (:69,281).
@@ -91,7 +109,6 @@ class GoalViolationDetector:
             return None
         gen = self._lm.model_generation().as_tuple()
         self.last_checked_generation = gen
-        arrays = BrokerArrays.from_model(model)
         fixable: List[str] = []
         unfixable: List[str] = []
         rf_max = int(np.asarray(model.partition_replication_factor()).max(initial=0))
@@ -100,9 +117,7 @@ class GoalViolationDetector:
             provision_verdict_for_goal)
         provision = ProvisionResponse()
         view = host_view(model)
-        for spec in goals_by_priority(self._goals):
-            satisfied = bool(kernels.goal_satisfied(spec, model, arrays,
-                                                    self._constraint))
+        for spec, satisfied in zip(goals_by_priority(self._goals), sat):
             provision.aggregate(provision_verdict_for_goal(
                 spec, model, self._constraint, satisfied, view))
             if satisfied:

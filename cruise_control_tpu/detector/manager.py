@@ -102,6 +102,20 @@ class AnomalyDetectorManager:
         self._lock = threading.RLock()
         # (detector, interval_ms, last_run_ms, is_multi) registered sources.
         self._detectors: List[List] = []
+        # Heal-pipeline sensors registered eagerly so the /metrics catalog is
+        # deterministic (the per-anomaly-class rate counters stay
+        # conditional — documented in prose, not table rows).
+        self._heal_hist = SENSORS.histogram(
+            "AnomalyDetector.heal-duration-seconds",
+            help="Wall time of each self-healing fix, detection to "
+                 "executor dispatch")
+        self._heals_started = SENSORS.counter(
+            "AnomalyDetector.heals-started",
+            help="Self-healing fixes that started an execution")
+        self._heals_failed = SENSORS.counter(
+            "AnomalyDetector.heals-failed",
+            help="Self-healing fixes that failed to start (including "
+                 "exceptions raised by the fix)")
 
     @property
     def notifier(self) -> AnomalyNotifier:
@@ -197,10 +211,21 @@ class AnomalyDetectorManager:
         started = False
         if self._facade is not None:
             self.state.ongoing_self_healing = anomaly.reason()
-            try:
-                started = bool(anomaly.fix(self._facade))
-            finally:
-                self.state.ongoing_self_healing = None
+            # A raising fix() must behave like a failed one: clear the
+            # ongoing flag, record FIX_FAILED_TO_START, and keep draining
+            # the queue — the drain loop holds the manager lock, so a
+            # propagating exception would wedge every later detection.
+            with TRACE.span("detector.heal",
+                            anomaly=type(anomaly).__name__) as sp, \
+                    self._heal_hist.time():
+                try:
+                    started = bool(anomaly.fix(self._facade))
+                except Exception as exc:  # noqa: BLE001
+                    sp.annotate(error=type(exc).__name__)
+                finally:
+                    self.state.ongoing_self_healing = None
+                sp.annotate(started=started)
+            (self._heals_started if started else self._heals_failed).inc()
         self.state.update_status(
             anomaly, "FIX_STARTED" if started else "FIX_FAILED_TO_START", now_ms)
         return 1

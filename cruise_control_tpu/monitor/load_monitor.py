@@ -263,6 +263,13 @@ class LoadMonitor:
             self._store.store_samples(samples)
         return n
 
+    def broker_history(self):
+        """The (broker × window × metric) history tensor the device detector
+        scores per tick — the broker aggregator's ``AggregationResult``
+        (``values`` f32[E, W, M] plus the ``window_valid`` mask and
+        ``generation`` stamp the scorer's dispatch cache keys on)."""
+        return self.broker_aggregator.aggregate()
+
     def broker_health_metrics(self) -> Dict[int, Dict[str, float]]:
         """{broker → {metric name → latest collapsed value}} for the
         executor's ConcurrencyAdjuster (Executor.java:335-447 reads live

@@ -17,6 +17,7 @@ import enum
 import json
 import os
 import threading
+import zlib
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from cruise_control_tpu.monitor.metadata import ClusterMetadata
@@ -101,7 +102,11 @@ class SyntheticWorkloadSampler(MetricSampler):
         self._seed = seed
 
     def _partition_scale(self, topic: str, partition: int) -> float:
-        h = hash((self._seed, topic, partition)) & 0xFFFF
+        # crc32, not hash(): builtin str hashing is randomized per process
+        # (PYTHONHASHSEED), which made "deterministic" quietly mean
+        # "deterministic within one interpreter" — plan sizes, and any test
+        # or bench thresholds derived from them, drifted across runs.
+        h = zlib.crc32(f"{self._seed}/{topic}/{partition}".encode()) & 0xFFFF
         return 0.25 + 1.5 * (h / 0xFFFF)
 
     def get_samples(self, cluster, partitions, start_ms, end_ms,

@@ -19,8 +19,11 @@ sensor added without a docs row, a docs row whose sensor is gone, or help
 text that no longer matches the code.  Families that only register under
 special conditions (``GoalOptimizer.compile-ceiling-clamps`` needs the
 compile ceiling to actually clamp; ``AnomalyDetector.<Class>-rate`` needs
-a handled anomaly) are documented in prose below the table, not as rows —
-the check compares exactly what this deterministic exercise registers.
+a handled anomaly of that class — the exercise drives exactly one broker
+failure through the heal pipeline, so ``BrokerFailures-rate`` and the heal
+counters ARE table rows while the other class rates stay prose) are
+documented in prose below the table, not as rows — the check compares
+exactly what this deterministic exercise registers.
 Run by tests/test_sensor_docs.py, so the docs cannot drift silently.
 """
 
@@ -83,10 +86,28 @@ def build_stack():
                        hard_goals=["RackAwareGoal", "DiskCapacityGoal"],
                        warm_start_enabled=True,
                        warm_start_delta_threshold=1.0)
-    mgr = AnomalyDetectorManager(SelfHealingNotifier(), cc,
+    # Self-healing enabled with zero thresholds so the exercise below can
+    # drive one broker failure through the full heal pipeline (detect →
+    # notifier FIX → warm-seeded remove) and its sensor families register.
+    from cruise_control_tpu.detector.anomalies import AnomalyType
+    notifier = SelfHealingNotifier(
+        self_healing_enabled=dict.fromkeys(AnomalyType, True),
+        broker_failure_alert_threshold_ms=0,
+        broker_failure_self_healing_threshold_ms=0)
+    mgr = AnomalyDetectorManager(notifier, cc,
                                  executor_busy=lambda: ex.has_ongoing_execution)
-    from cruise_control_tpu.detector.detectors import BrokerFailureDetector
+    from cruise_control_tpu.detector import device as dd
+    from cruise_control_tpu.detector.detectors import (BrokerFailureDetector,
+                                                       MetricAnomalyDetector)
     mgr.register_detector(BrokerFailureDetector(mc), interval_ms=1)
+    # The tensor-native finders share one DeviceScorer, so constructing them
+    # registers the device-score-dispatches gauge and one detector tick
+    # scores the whole fleet in a single batched dispatch.
+    scorer = dd.DeviceScorer()
+    mgr.register_detector(
+        MetricAnomalyDetector(lm, [dd.DeviceMetricAnomalyFinder(scorer=scorer),
+                                   dd.DeviceSlowBrokerFinder(scorer=scorer)]),
+        interval_ms=1)
     return CruiseControlApi(cc, detector_manager=mgr, sampler=sampler), mgr
 
 
@@ -162,6 +183,23 @@ def exercise(api, mgr) -> None:
                          "LeaderReplicaDistributionGoal"],
                  raise_on_hard_failure=False, fused=True, pipeline=True)
     mgr.run_detectors_once(now_ms=1)
+    # Heal pipeline: kill one broker and let the detector → notifier(FIX) →
+    # facade chain run a self-healing remove.  The standing proposal from the
+    # warm rebalance above seeds the heal solve, so the families this
+    # registers — CruiseControl.heal-warm-solves / heal-cold-solves and the
+    # AnomalyDetector.BrokerFailures-rate counter — appear deterministically
+    # (the other per-anomaly-class rates stay conditional).
+    import dataclasses
+    mc = lm._metadata
+    cluster = mc.cluster()
+    victim = max(b.broker_id for b in cluster.brokers)
+    mc.refresh(dataclasses.replace(cluster, brokers=tuple(
+        dataclasses.replace(b, is_alive=(b.broker_id != victim))
+        for b in cluster.brokers)))
+    mgr.run_detectors_once(now_ms=2)
+    if not mgr.handle_anomalies_once(now_ms=2):
+        print("warning: heal-pipeline exercise handled no anomaly",
+              file=sys.stderr)
 
 
 def catalog_markdown(catalog) -> str:

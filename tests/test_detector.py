@@ -283,3 +283,33 @@ def test_manager_detector_intervals():
     mgr.run_detectors_once(500)   # too soon
     mgr.run_detectors_once(1500)
     assert det.runs == 2
+
+
+def test_failed_heal_does_not_wedge_manager():
+    """A fix() that raises must clear ongoing_self_healing, record
+    FIX_FAILED_TO_START, and leave the manager able to drain later
+    detections — the drain loop holds the manager lock, so a propagating
+    exception used to wedge every subsequent tick."""
+    class BoomFacade:
+        def __getattr__(self, name):
+            def call(*args, **kwargs):
+                raise RuntimeError("heal exploded")
+            return call
+
+    notifier = SelfHealingNotifier(
+        self_healing_enabled=dict.fromkeys(AnomalyType, True),
+        broker_failure_alert_threshold_ms=0,
+        broker_failure_self_healing_threshold_ms=0)
+    mgr = AnomalyDetectorManager(notifier, BoomFacade())
+    mgr.enqueue(BrokerFailures(detection_time_ms=1, failed_brokers={2: 0}), 1)
+    mgr.enqueue(GoalViolations(detection_time_ms=1, fixable_goals=["X"]), 1)
+    assert mgr.handle_anomalies_once(now_ms=10) == 2
+    assert mgr.state.ongoing_self_healing is None
+    st = mgr.state.to_dict(notifier)
+    assert st["recentAnomalies"]["BROKER_FAILURE"][0]["status"] == \
+        "FIX_FAILED_TO_START"
+    assert st["recentAnomalies"]["GOAL_VIOLATION"][0]["status"] == \
+        "FIX_FAILED_TO_START"
+    # The manager is not wedged: a later detection still drains.
+    mgr.enqueue(GoalViolations(detection_time_ms=2, fixable_goals=["X"]), 20)
+    assert mgr.handle_anomalies_once(now_ms=30) == 1

@@ -1,0 +1,217 @@
+"""Tensor-native detector tests (detector/device.py): host-vs-device
+differentials with the scalar finders as oracle, dispatch-count pins (one
+batched program per tick, fleet-size independent; goal violations through
+ONE fused sweep), and the heal pipeline's warm-seed path — detector fires →
+delta probe → warm solve seeded from the standing proposal with the dead
+broker force-joined into the seed frontier.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.common.sensors import SENSORS
+from cruise_control_tpu.detector import device as dd
+from cruise_control_tpu.detector.detectors import (GoalViolationDetector,
+                                                   PercentileMetricAnomalyFinder,
+                                                   SlowBrokerFinder)
+from tests.test_detector import broker_agg_with_history, make_md, sampled_lm
+
+W = 300_000
+
+
+def _device_pair():
+    scorer = dd.DeviceScorer()
+    return (dd.DeviceMetricAnomalyFinder(scorer=scorer),
+            dd.DeviceSlowBrokerFinder(scorer=scorer))
+
+
+# -- host-vs-device differentials (CRUISE_DETECTOR_ORACLE=1 makes every
+# device flagging pass re-run the scalar oracle and raise on divergence) ----
+
+CLEAN = {b: [5, 5, 5, 5, 5, 5] for b in range(4)}
+SINGLE_SLOW = {0: [5, 5, 5, 5, 5, 100],
+               1: [5, 5, 5, 5, 5, 5],
+               2: [5, 5, 5, 5, 5, 6],
+               3: [5, 5, 5, 5, 5, 5]}
+# Engineered so the latest value lands exactly ON the host threshold
+# (percentile(hist)=10, margin 1.5 → threshold 15): strict > must agree
+# bit-for-bit between np.percentile and the masked device sort.
+BORDERLINE = {0: [10, 10, 10, 10, 10, 15],
+              1: [10, 10, 10, 10, 10, 16],
+              2: [10, 10, 10, 10, 10, 10],
+              3: [10, 10, 10, 10, 10, 10]}
+
+
+@pytest.mark.parametrize("history,expect_metric", [
+    (CLEAN, set()),
+    (SINGLE_SLOW, {0}),
+    (BORDERLINE, {1}),
+])
+def test_metric_finder_matches_oracle(monkeypatch, history, expect_metric):
+    monkeypatch.setenv("CRUISE_DETECTOR_ORACLE", "1")
+    agg = broker_agg_with_history(history)
+    metric, _ = _device_pair()
+    out = metric.anomalies(agg)  # raises AssertionError on divergence
+    assert set(out) == expect_metric
+    want = PercentileMetricAnomalyFinder("BROKER_LOG_FLUSH_TIME_MS_999TH") \
+        .anomalies(agg)
+    assert set(out) == set(want)
+
+
+@pytest.mark.parametrize("history", [CLEAN, SINGLE_SLOW, BORDERLINE])
+def test_slow_finder_matches_oracle(monkeypatch, history):
+    monkeypatch.setenv("CRUISE_DETECTOR_ORACLE", "1")
+    agg = broker_agg_with_history(history)
+    _, slow = _device_pair()
+    res = agg.aggregate()
+    from cruise_control_tpu.monitor.metricdef import KAFKA_METRIC_DEF
+    mid = KAFKA_METRIC_DEF.metric_info(SlowBrokerFinder.METRIC).metric_id
+    bmid = KAFKA_METRIC_DEF.metric_info(SlowBrokerFinder.BYTES_METRIC).metric_id
+    got = slow._suspects(res, mid, bmid)  # raises on divergence
+    want = SlowBrokerFinder()._suspects(res, mid, bmid)
+    assert got == want
+
+
+def test_oracle_raises_on_forced_divergence(monkeypatch):
+    """The differential harness actually bites: device flags forced away
+    from the scalar oracle's must raise, not silently disagree."""
+    monkeypatch.setenv("CRUISE_DETECTOR_ORACLE", "1")
+    agg = broker_agg_with_history(SINGLE_SLOW)
+    metric, _ = _device_pair()
+    real = dd.DeviceScorer.scores
+
+    def broken(self, res, mid, bytes_mid):
+        out = dict(real(self, res, mid, bytes_mid))
+        out["metric_flag"] = np.zeros_like(out["metric_flag"])
+        return out
+
+    monkeypatch.setattr(dd.DeviceScorer, "scores", broken)
+    with pytest.raises(AssertionError, match="diverge"):
+        metric.anomalies(agg)
+
+
+# -- dispatch economy -------------------------------------------------------
+
+@pytest.mark.parametrize("num_brokers", [8, 64])
+def test_one_scoring_dispatch_per_tick(num_brokers):
+    """Both finder families share ONE compiled dispatch per aggregation
+    generation, independent of fleet size — the no-per-broker-Python-loop
+    pin from the issue's acceptance criteria."""
+    history = {b: [5, 5, 5, 5, 5, 5] for b in range(num_brokers)}
+    history[3] = [5, 5, 5, 5, 5, 500]
+    agg = broker_agg_with_history(history)
+    metric, slow = _device_pair()
+    before = dd.DEVICE_COUNTERS["dispatches"]
+    metric.anomalies(agg)
+    slow.detect(agg, now_ms=0)
+    assert dd.DEVICE_COUNTERS["dispatches"] == before + 1
+    # Same generation re-read: cache hit, still one dispatch.
+    metric.anomalies(agg)
+    assert dd.DEVICE_COUNTERS["dispatches"] == before + 1
+    # New window → new generation → exactly one more dispatch.
+    for b in history:
+        agg.add_sample(b, 7 * W, {"BROKER_LOG_FLUSH_TIME_MS_999TH": 5.0,
+                                  "LEADER_BYTES_IN": 100.0})
+    metric.anomalies(agg)
+    slow.detect(agg, now_ms=1)
+    assert dd.DEVICE_COUNTERS["dispatches"] == before + 2
+
+
+def test_goal_violation_single_fused_sweep(monkeypatch):
+    """DeviceGoalViolationDetector answers every detection goal with ONE
+    fused stack-satisfied sweep dispatch (the PR-8 confirm-sweep), where the
+    scalar parent pays one kernel dispatch per goal."""
+    monkeypatch.setenv("CRUISE_DETECTOR_ORACLE", "1")
+    from cruise_control_tpu.analyzer import optimizer as opt
+    lm = sampled_lm(make_md(num_brokers=6))
+    goals = ["RackAwareGoal", "ReplicaCapacityGoal", "DiskCapacityGoal"]
+    det = dd.DeviceGoalViolationDetector(lm, goals)
+    before = opt.SWEEP_COUNTERS["dispatches"]
+    det.detect(now_ms=0)  # oracle-checked against the scalar per-goal path
+    assert opt.SWEEP_COUNTERS["dispatches"] == before + 1
+    assert det.balancedness_score is not None
+
+
+def test_goal_violation_offline_sentinel():
+    md = make_md(num_brokers=6, alive={0, 1, 2, 3, 4})
+    lm = sampled_lm(md)
+    det = dd.DeviceGoalViolationDetector(lm, ["RackAwareGoal"])
+    scalar = GoalViolationDetector(lm, ["RackAwareGoal"])
+    assert det._goal_satisfactions(lm.cluster_model()) == \
+        scalar._goal_satisfactions(lm.cluster_model())
+
+
+# -- heal pipeline: warm solve seeded from the standing proposal ------------
+
+def _heal_stack():
+    """Facade + monitor stack with warm start on and a permissive delta
+    threshold (mirrors tools/dump_sensors.build_stack)."""
+    from cruise_control_tpu.api.facade import CruiseControl
+    from cruise_control_tpu.executor.admin import InMemoryClusterAdmin
+    from cruise_control_tpu.executor.executor import Executor
+    from cruise_control_tpu.monitor.capacity import StaticCapacityResolver
+    from cruise_control_tpu.monitor.load_monitor import LoadMonitor
+    from cruise_control_tpu.monitor.metadata import MetadataClient
+    from cruise_control_tpu.monitor.sampling import SyntheticWorkloadSampler
+
+    mc = MetadataClient(make_md(num_brokers=6, rf=2))
+    lm = LoadMonitor(mc, StaticCapacityResolver(), num_partition_windows=3,
+                     partition_window_ms=W)
+    lm.start_up()
+    sampler = SyntheticWorkloadSampler()
+    for w in range(4):
+        lm.fetch_once(sampler, w * W, w * W + 1)
+    admin = InMemoryClusterAdmin(mc, latency_polls=1)
+    ex = Executor(admin, mc)
+    cc = CruiseControl(lm, ex, admin,
+                       goals=["RackAwareGoal", "DiskCapacityGoal",
+                              "ReplicaDistributionGoal"],
+                       hard_goals=["RackAwareGoal", "DiskCapacityGoal"],
+                       warm_start_enabled=True,
+                       warm_start_delta_threshold=1.0)
+    return cc, lm, mc
+
+
+def _kill_broker(mc, broker_id):
+    cluster = mc.cluster()
+    brokers = tuple(dataclasses.replace(b, is_alive=(b.broker_id != broker_id))
+                    for b in cluster.brokers)
+    mc.refresh(dataclasses.replace(cluster, brokers=brokers))
+
+
+def test_heal_warm_seed_force_joins_dead_broker():
+    cc, lm, mc = _heal_stack()
+    assert cc.proposals() is not None  # prime the standing entry
+    _kill_broker(mc, 1)
+    model, naming = cc._model_naming()
+    options = cc._base_options(model, naming, None)
+    ws = cc._heal_warm_start(model, options, "test")
+    assert ws is not None
+    row = list(naming["brokers"]).index(1)
+    active = np.asarray(ws.active_mask)
+    assert bool(active[row])  # dead broker is live optimization surface
+
+
+def test_remove_brokers_self_healing_warm_solves_from_standing():
+    cc, lm, mc = _heal_stack()
+    assert cc.proposals() is not None
+    _kill_broker(mc, 1)
+    warms = SENSORS.counter("CruiseControl.heal-warm-solves",
+                            labels={"op": "remove_brokers"})
+    before = warms.count
+    ok = cc.remove_brokers([1], self_healing=True)
+    assert warms.count == before + 1
+    assert ok is True
+
+
+def test_heal_falls_cold_without_standing():
+    cc, lm, mc = _heal_stack()  # no proposals() — nothing standing
+    _kill_broker(mc, 1)
+    colds = SENSORS.counter("CruiseControl.heal-cold-solves",
+                            labels={"op": "remove_brokers"})
+    before = colds.count
+    ok = cc.remove_brokers([1], self_healing=True)
+    assert colds.count == before + 1
+    assert ok is True

@@ -1,0 +1,144 @@
+"""Render a chaos-fleet benchmark artifact; summarize the heal suite.
+
+The chaos bench (``python bench.py --chaos``) drives fault scenarios — mass
+broker death, a full rack outage, a disk failure, a heterogeneous-capacity
+fleet, hot-topic skew, a slow broker — through the simulated fleet and
+records, per scenario, time-to-detect, time-to-heal, balancedness
+before/after, and whether the heal solve was warm (seeded from the standing
+proposal) or cold.  This tool turns that artifact into something a human
+(ASCII table + heal-time bars) or a later revision (``--json`` one-liner)
+can read:
+
+- ``python tools/chaos_report.py CHAOS_mid.json``   render a bench artifact
+- ``--json`` emits the report as one JSON line instead of the table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_BAR_W = 40
+
+
+def normalize(record: dict) -> dict:
+    """Common shape from a CHAOS_*.json artifact (or the bench's final
+    stdout record, which carries the same fields)."""
+    if "scenarios" not in record:
+        raise SystemExit(
+            "unrecognized record: need a CHAOS_*.json artifact ('scenarios' "
+            "— did you pass an EXEC/WARM artifact to the wrong report tool?)")
+    return {
+        "source": record.get("metric", "chaos_artifact"),
+        "num_brokers": record.get("num_brokers"),
+        "num_replicas": record.get("num_replicas"),
+        "detection_interval_s": record.get("detection_interval_s"),
+        "scenarios": list(record["scenarios"]),
+        "scenarios_total": record.get("scenarios_total",
+                                      len(record["scenarios"])),
+        "scenarios_detected": record.get("scenarios_detected"),
+        "scenarios_healed": record.get("scenarios_healed"),
+        "scenarios_warm_healed": record.get("scenarios_warm_healed"),
+        "time_to_heal_max_s": record.get("time_to_heal_max_s"),
+        "time_to_heal_mean_s": record.get("time_to_heal_mean_s"),
+    }
+
+
+def build_report(record: dict) -> dict:
+    n = normalize(record)
+    sc = n["scenarios"]
+    healed = [s for s in sc if s.get("healed")]
+    # The suite's invariants: every injected fault is detected and healed,
+    # the detector goes quiet after the heal (no detect→fix flapping), at
+    # least one heal rode the standing proposal's warm seed, and no healed
+    # scenario ends less balanced than it started.
+    n["all_detected"] = all(s.get("detected") for s in sc)
+    n["all_healed"] = bool(sc) and len(healed) == len(sc)
+    n["all_post_clean"] = bool(healed) and all(s.get("post_clean")
+                                               for s in healed)
+    n["warm_heal_present"] = any(s.get("warm") for s in healed)
+    n["balancedness_recovered"] = all(
+        (s.get("balancedness_after") or 0.0)
+        >= (s.get("balancedness_before") or 0.0) - 1e-9 for s in healed)
+    return n
+
+
+def _bar(v: float, vmax: float) -> str:
+    if vmax <= 0:
+        return ""
+    return "#" * max(1 if v > 0 else 0, round(_BAR_W * v / vmax))
+
+
+def print_report(rep: dict) -> None:
+    print(f"source={rep['source']} brokers={rep['num_brokers']} "
+          f"replicas={rep['num_replicas']} "
+          f"detection_interval={rep['detection_interval_s']}s")
+    print(f"scenarios: {rep['scenarios_detected']}/{rep['scenarios_total']} "
+          f"detected, {rep['scenarios_healed']} healed "
+          f"({rep['scenarios_warm_healed']} warm)  "
+          f"heal max={rep['time_to_heal_max_s']}s "
+          f"mean={rep['time_to_heal_mean_s']}s")
+    print()
+    vmax = max((s.get("time_to_heal_s") or 0.0) for s in rep["scenarios"])
+    print(f"{'scenario':<24} {'detect(s)':>9} {'heal(s)':>8} {'solve':>5} "
+          f"{'bal before->after':>18} {'clean':>5}  heal time")
+    for s in rep["scenarios"]:
+        det = s.get("time_to_detect_s")
+        det_s = "-" if det is None else f"{det:.0f}"
+        heal = s.get("time_to_heal_s")
+        heal_s = "-" if heal is None else f"{heal:.1f}"
+        solve = ("warm" if s.get("warm")
+                 else "cold" if s.get("healed") else "-")
+        ba, bb = s.get("balancedness_before"), s.get("balancedness_after")
+        bal = (f"{ba:.1f} -> {bb:.1f}" if ba is not None and bb is not None
+               else "-")
+        clean = ("yes" if s.get("post_clean")
+                 else "NO" if s.get("healed") else "-")
+        print(f"{s['scenario']:<24} {det_s:>9} {heal_s:>8} {solve:>5} "
+              f"{bal:>18} {clean:>5}  {_bar(heal or 0.0, vmax)}")
+    print()
+    for s in rep["scenarios"]:
+        fl = s.get("flight")
+        if fl:
+            steps = ", ".join(f"{g['goal']}:{g['flight_steps']}" for g in fl)
+            print(f"  {s['scenario']:<24} heal flight  {steps}")
+    print(f"all_detected: {rep['all_detected']}  "
+          f"all_healed: {rep['all_healed']}  "
+          f"all_post_clean: {rep['all_post_clean']}")
+    print(f"warm_heal_present: {rep['warm_heal_present']}  "
+          f"balancedness_recovered: {rep['balancedness_recovered']}")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("record", help="CHAOS_*.json artifact")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as one JSON line (no table)")
+    args = ap.parse_args()
+    with open(args.record) as f:
+        text = f.read().strip()
+    try:
+        record = json.loads(text)
+    except json.JSONDecodeError:
+        # bench output is .jsonl (one record per line, last wins)
+        record = json.loads(text.splitlines()[-1])
+    rep = build_report(record)
+    if args.json:
+        scenarios = rep.pop("scenarios")
+        rep["scenarios"] = [
+            {k: s.get(k) for k in ("scenario", "detected", "time_to_detect_s",
+                                   "healed", "time_to_heal_s", "warm",
+                                   "post_clean", "balancedness_before",
+                                   "balancedness_after")}
+            for s in scenarios]
+        print(json.dumps(rep), flush=True)
+    else:
+        print_report(rep)
+
+
+if __name__ == "__main__":
+    main()
