@@ -568,6 +568,300 @@ def run_warm_rung(scale: str, max_candidates, fast: bool) -> dict:
     return rec
 
 
+def run_replan_rung(scale: str, max_candidates, fast: bool) -> dict:
+    """--replan: interruptible-execution rung.  One snapshot, one optimize
+    pass, one mid-flight load churn event (the --warm rung's perturbation
+    family) visible to every leg, then three executions of the same plan
+    against identical simulated fleets:
+
+      static  — execute the original plan to the end, blind to the churn;
+      replan  — at a phase-boundary replan point a warm re-solve against
+                the churned, partially-moved model patches the live queue
+                (cancel-what-changed, keep-what-still-helps, add the rest)
+                and rebases the ledger's balancedness scorer;
+      resume  — the replan leg again, but killed mid-phase after the replan
+                landed (SimulatedCrash) and resumed from the journal; the
+                rung FAILS unless the resumed run's final placement and
+                byte totals are identical to the uninterrupted replan leg.
+
+    Writes REPLAN_<rung>.json (tools/execution_report.py renders the replan
+    markers on the curve).  The rung needs a plan with real inter-broker
+    movement — replan points sit inside the inter-broker phase — so rungs
+    whose optimized plan is leadership-only (the ~300-replica small rung)
+    fail fast with a clear message; mid is the default and the yardstick."""
+    brokers, racks, topics, ppt, rf = SCALES[scale]
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cruise_control_tpu.analyzer import optimizer as opt
+    from cruise_control_tpu.analyzer import proposals as props
+    from cruise_control_tpu.analyzer.state import WarmStart, model_delta
+    from cruise_control_tpu.executor import simulate as sim
+    from cruise_control_tpu.executor.executor import (ReplanDirective,
+                                                      SimulatedCrash)
+    from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster
+
+    spec = ClusterSpec(num_brokers=brokers, num_racks=racks, num_topics=topics,
+                       mean_partitions_per_topic=ppt, replication_factor=rf,
+                       distribution="exponential", seed=2026)
+    model = jax.device_put(generate_cluster(spec))
+    jax.block_until_ready(model)
+    num_replicas = int(model.replica_valid.sum())
+
+    run0 = opt.optimize(opt.donation_copy(model), STACK,
+                        raise_on_hard_failure=False, fused=True,
+                        max_candidates_per_step=max_candidates, fast_mode=fast,
+                        donate_model=True)
+    proposals = props.diff(model, run0.model)
+    goal_names = [g.name for g in run0.goal_results]
+    by_part = {p.partition: p for p in proposals}
+    inter_bytes = sum(int(p.partition_size * 1e6) * len(p.replicas_to_add)
+                      for p in proposals)
+    if inter_bytes <= 0:
+        raise SystemExit(
+            f"replan rung: the optimized {scale} plan moves no replicas "
+            f"({len(proposals)} leadership-only proposals) — nothing to "
+            "replan; use a rung whose stack produces inter-broker movement "
+            "(mid does)")
+    # Lower throttle floor than --execute: this rung needs a real poll
+    # curve at every scale (the replan point, the crash point and at least
+    # one post-crash poll must all be distinct ticks), so tiny plans drain
+    # over O(100) virtual ticks instead of a handful.
+    rate = max(50_000.0, inter_bytes / max(brokers, 1) / 300.0)
+
+    # The churn event: the same sibling-consistent ±10% load tick on ≤5% of
+    # brokers the --warm rung replays — computed once up front so every leg
+    # sees the identical shifted loads.
+    rng = np.random.default_rng(7)
+    k = max(1, int(model.num_brokers * 0.05))
+    chosen = np.sort(np.asarray(rng.choice(model.num_brokers, size=k,
+                                           replace=False)))
+    rb_ = np.asarray(model.replica_broker)
+    rp_ = np.asarray(model.replica_partition)
+    lead_ = np.asarray(model.replica_is_leader) & np.asarray(model.replica_valid)
+    ll = np.array(model.replica_load_leader)
+    lf = np.array(model.replica_load_follower)
+    hot = np.zeros(model.num_partitions, dtype=bool)
+    hot[rp_[lead_ & np.isin(rb_, chosen)]] = True
+    factor = np.ones((model.num_partitions, 1), dtype=ll.dtype)
+    factor[hot] = rng.uniform(0.9, 1.1, size=(int(hot.sum()), 1))
+    churned = model.replace(replica_load_leader=jnp.asarray(ll * factor[rp_]),
+                            replica_load_follower=jnp.asarray(lf * factor[rp_]))
+
+    pr_table = np.asarray(model.partition_replicas)
+
+    def blend(landed):
+        """The churned model with every landed partition's placement swapped
+        to its original-plan target — the bench's stand-in for re-reading
+        cluster state mid-execution (the facade's replanner gets this for
+        free from the load monitor)."""
+        rb = np.array(churned.replica_broker)
+        rd = np.array(churned.replica_disk)
+        ld = np.array(churned.replica_is_leader)
+        for pid in landed:
+            prop = by_part.get(pid)
+            if prop is None:
+                continue
+            slots = pr_table[pid][pr_table[pid] >= 0]
+            if len(slots) != len(prop.new_replicas):
+                continue
+            for i, (s, rpl) in enumerate(zip(slots, prop.new_replicas)):
+                rb[s] = rpl.broker
+                if rpl.disk >= 0:
+                    rd[s] = rpl.disk
+                ld[s] = (i == 0)
+        return churned.replace(replica_broker=jnp.asarray(rb),
+                               replica_disk=jnp.asarray(rd),
+                               replica_is_leader=jnp.asarray(ld))
+
+    def make_replanner():
+        """One churn event → one re-solve: the directive's proposals come
+        from a warm solve over the blended (churned + partially-moved)
+        model, seeded from the original converged placement through the
+        same WarmStart/model_delta probe the facade's replanner uses."""
+        state = {"rounds": 0}
+
+        def replanner(landed, inflight):
+            if state["rounds"] >= 1:
+                return None
+            blended = blend(landed)
+            delta = model_delta(run0.model, blended)
+            ws = WarmStart(prev_model=run0.model,
+                           active_mask=(delta.changed_mask
+                                        if delta is not None else None))
+            run2 = opt.optimize(opt.donation_copy(blended), STACK,
+                                raise_on_hard_failure=False, fused=True,
+                                fuse_group_size=1,
+                                max_candidates_per_step=max_candidates,
+                                fast_mode=fast, donate_model=True,
+                                warm_start=ws)
+            state["rounds"] += 1
+            return ReplanDirective(
+                props.diff(blended, run2.model),
+                opt.PlacementScorer(blended, run2.model, goal_names),
+                info={"landed": len(landed), "inflight": len(inflight)})
+
+        return replanner
+
+    def leg_record(result, ex):
+        prog = ex.progress(verbose=True)
+        scored = [c["balancedness"] for c in prog["checkpoints"]
+                  if c.get("balancedness") is not None]
+        return prog, {
+            "fleet_s": round(prog["elapsedMs"] / 1000.0, 3),
+            "completed": result.completed,
+            "aborted": result.aborted,
+            "polls": result.polls,
+            "bytes_moved": prog["bytesMoved"],
+            "balancedness_final": scored[-1] if scored else None,
+        }
+
+    def placement_sig(admin):
+        return sorted((p.tp, p.leader, tuple(sorted(p.replicas)))
+                      for p in admin.metadata_client.cluster().partitions)
+
+    # Leg 1: static — the original plan, blind to the churn.
+    t0 = time.monotonic()
+    res_s, ex_s, ad_s = sim.run_simulated_execution(
+        model, proposals, model_after=run0.model, goal_names=goal_names,
+        tick_ms=1000, rate_bytes_per_sec=rate)
+    host_static_s = time.monotonic() - t0
+    prog_s, static_leg = leg_record(res_s, ex_s)
+    inter_polls = next((ph["polls"] for ph in prog_s["phases"]
+                        if ph["phase"] == "inter_broker"), 0)
+    # Replan point: one third into the (static) inter-broker phase — legs
+    # are poll-identical up to the first replan, so the point is in-phase
+    # for the replan legs too.
+    replan_at = max(2, inter_polls // 3)
+
+    # Leg 2: replan — same plan, same fleet, live queue patched mid-flight.
+    rp_r = make_replanner()
+    t0 = time.monotonic()
+    res_r, ex_r, ad_r = sim.run_simulated_execution(
+        model, proposals, model_after=run0.model, goal_names=goal_names,
+        tick_ms=1000, rate_bytes_per_sec=rate,
+        replanner=rp_r, replan_interval_polls=replan_at)
+    host_replan_s = time.monotonic() - t0
+    prog_r, replan_leg = leg_record(res_r, ex_r)
+    replan_leg["replans"] = prog_r.get("replans", [])
+    if not replan_leg["replans"]:
+        raise SystemExit("replan rung: the replan round never fired "
+                         f"(interval={replan_at}, polls={prog_r['polls']})")
+
+    # Leg 3: replan + kill + resume.  Leg 2 is this leg's deterministic
+    # twin, so its telemetry gives a crash point that is guaranteed to be
+    # (a) after the replan landed in the journal and (b) before the run
+    # ends: the tick after the first replan.  (The ledger's final count
+    # includes one forced end-of-run poll that is not a crashable tick.)
+    import tempfile
+    jp = os.path.join(tempfile.gettempdir(), f"cc_replan_{scale}.journal")
+    crash_at = replan_leg["replans"][0]["poll"] + 1
+    if crash_at > prog_r["polls"] - 1:
+        raise SystemExit(f"replan rung: no crashable tick after the replan "
+                         f"(replan @poll {crash_at - 1}, "
+                         f"{prog_r['polls']} ledger polls)")
+    ex_c, ad_c, pnames, scorer_c = sim.build_simulated_execution(
+        model, proposals, model_after=run0.model, goal_names=goal_names,
+        tick_ms=1000, rate_bytes_per_sec=rate)
+    rp_c = make_replanner()
+    t0 = time.monotonic()
+    crashed = False
+    try:
+        ex_c.execute_proposals(
+            proposals, pnames, max_polls=200_000, poll_interval_s=0.0,
+            replication_throttle=int(rate),
+            concurrency_adjust_metrics=sim.synthetic_health_metrics(),
+            balancedness_scorer=scorer_c,
+            replanner=rp_c, replan_interval_polls=replan_at,
+            journal_path=jp, crash_after_polls=crash_at)
+    except SimulatedCrash:
+        crashed = True
+    if not crashed:
+        raise SystemExit(f"replan rung: crash_after_polls={crash_at} "
+                         "never fired")
+    res_c = ex_c.resume(jp, poll_interval_s=0.0,
+                        concurrency_adjust_metrics=sim.synthetic_health_metrics())
+    host_resume_s = time.monotonic() - t0
+    try:
+        os.unlink(jp)
+    except OSError:
+        pass
+    prog_c, resume_leg = leg_record(res_c, ex_c)
+    resume_leg["crash_after_polls"] = crash_at
+    # The acceptance gate: kill+resume must land the IDENTICAL placement
+    # (and byte totals) as the uninterrupted replan leg.
+    if placement_sig(ad_c) != placement_sig(ad_r):
+        raise SystemExit("replan rung: resumed placement diverged from the "
+                         "uninterrupted replan leg")
+    for key in ("totalTasks", "totalBytes", "bytesMoved", "bytesInFlight"):
+        if prog_c[key] != prog_r[key]:
+            raise SystemExit(f"replan rung: resumed ledger {key} "
+                             f"{prog_c[key]!r} != replan leg {prog_r[key]!r}")
+    resume_leg["identical_to_replan_leg"] = True
+
+    # Churn-aware yardstick: both finals scored by a before=churned-loads
+    # scorer.  The static leg lands every partition on the stale target;
+    # the replan leg's final curve point is already scored by the rebased
+    # (blended-before) scorer.
+    truth_static = opt.PlacementScorer(churned, run0.model, goal_names)
+    static_under_churn = float(truth_static.score_landed(
+        [frozenset(by_part)])[0]) if proposals else None
+    replan_under_churn = replan_leg["balancedness_final"]
+    # Acceptance gate: under churn the replanned execution must land at
+    # least as balanced as the static plan — the replanner re-solved for
+    # the loads the fleet actually has, the static plan cannot.
+    if (static_under_churn is not None and replan_under_churn is not None
+            and replan_under_churn < static_under_churn - 1e-6):
+        raise SystemExit(
+            f"replan rung: replanned final balancedness under churn "
+            f"{replan_under_churn:.3f} is below the static plan's "
+            f"{static_under_churn:.3f}")
+
+    speedup = static_leg["fleet_s"] / max(replan_leg["fleet_s"], 1e-9)
+    rec = {
+        "metric": f"replan_time_to_balanced_{scale}",
+        "value": replan_leg["fleet_s"],
+        "unit": "s",
+        # Fleet time relative to the static leg (>1 = replan finished
+        # sooner).  Not gated: churn can legitimately demand extra moves,
+        # so the balancedness gate above is the acceptance bar.
+        "vs_baseline": round(speedup, 3),
+        "host_wall_s": {"static": round(host_static_s, 3),
+                        "replan": round(host_replan_s, 3),
+                        "resume": round(host_resume_s, 3)},
+        "num_brokers": brokers,
+        "num_replicas": num_replicas,
+        "num_proposals": len(proposals),
+        "replan_interval_polls": replan_at,
+        "churned_brokers": [int(b) for b in chosen],
+        "plan": {"totalTasks": prog_r["totalTasks"],
+                 "totalBytes": prog_r["totalBytes"]},
+        "static": static_leg,
+        "replan": replan_leg,
+        "resume": resume_leg,
+        # Positive when cancelled moves outweigh churn-demanded additions;
+        # negative when the re-solve had to move MORE to fix the churn.
+        "bytes_moved_delta": (replan_leg["bytes_moved"]
+                              - static_leg["bytes_moved"]),
+        "balancedness_under_churn": {"static": static_under_churn,
+                                     "replan": replan_under_churn},
+        "throttle": {"rateBytesPerSec": rate, "tickMs": 1000},
+        "curve": [{k: v for k, v in cp.items()}
+                  for cp in prog_r["checkpoints"]],
+        "replans": prog_r.get("replans", []),
+        **({"fast_mode": True} if fast else {}),
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        f"REPLAN_{scale}.json")
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1, sort_keys=True)
+        f.write("\n")
+    rec["replan_artifact"] = os.path.basename(path)
+    return rec
+
+
 def _compile_ceiling_probe(constraint, options_cls, ceiling: int = 32_768) -> dict:
     """Probe candidate-width shapes past the 375k→500k single-chip compile
     wall THROUGH the integer ``CRUISE_TPU_COMPILE_CEILING`` gate: build the
@@ -1242,6 +1536,14 @@ def main() -> None:
                          "equisatisfaction and verifier enforced in-rung), "
                          "write PIPELINE_<rung>.json with the compile-"
                          "ceiling probe (default rung: mid)")
+    ap.add_argument("--replan", action="store_true",
+                    help="run the interruptible-execution twin rung(s) "
+                         "instead: execute one optimized plan static, "
+                         "replanned (live-queue patch from a warm re-solve "
+                         "after a mid-flight load churn) and "
+                         "replanned+killed+resumed from the journal "
+                         "(final-placement identity enforced in-rung), "
+                         "write REPLAN_<rung>.json (default rung: mid)")
     ap.add_argument("--chaos", action="store_true",
                     help="run the chaos-fleet rung(s) instead: engineered "
                          "failure scenarios (broker death, rack outage, disk "
@@ -1256,7 +1558,7 @@ def main() -> None:
         # so every heal solve's convergence rides the detector.heal trace.
         os.environ["CRUISE_FLIGHT_RECORDER"] = "1"
     default_rungs = ("mid" if (args.execute or args.warm or args.pipeline
-                               or args.chaos)
+                               or args.chaos or args.replan)
                      else "small,mid")
     scale_sel = args.rungs or os.environ.get("BENCH_SCALE") or default_rungs
     scales = (["small", "mid", "large"] if scale_sel == "ladder"
@@ -1297,13 +1599,15 @@ def main() -> None:
                   else "warm_vs_cold_speedup_small" if args.warm
                   else "pipeline_stack_speedup_small" if args.pipeline
                   else "chaos_time_to_heal_small" if args.chaos
+                  else "replan_time_to_balanced_small" if args.replan
                   else "wall_clock_to_goal_satisfying_proposal_small")
         _record_rung({"metric": metric, "value": 0.0, "unit": "s",
                       "vs_baseline": 0.0, "selftest": True,
                       **({"execute": True} if args.execute else {}),
                       **({"warm": True} if args.warm else {}),
                       **({"pipeline": True} if args.pipeline else {}),
-                      **({"chaos": True} if args.chaos else {})})
+                      **({"chaos": True} if args.chaos else {}),
+                      **({"replan": True} if args.replan else {})})
         while True:
             signal.pause()
 
@@ -1325,6 +1629,7 @@ def main() -> None:
                else run_pipeline_rung(s, max_candidates, fast)
                if args.pipeline
                else run_chaos_rung(s, max_candidates, fast) if args.chaos
+               else run_replan_rung(s, max_candidates, fast) if args.replan
                else run_rung(s, max_candidates, fast))
         cancel()
         rec["backend"] = platform

@@ -43,7 +43,9 @@ from cruise_control_tpu.analyzer.state import (OptimizationOptions, WarmStart,
                                                model_delta)
 from cruise_control_tpu.analyzer.verifier import VerificationError, verify_run
 from cruise_control_tpu.executor.admin import ClusterAdmin, ReassignmentRequest
-from cruise_control_tpu.executor.executor import Executor, OngoingExecutionError
+from cruise_control_tpu.executor.executor import (Executor,
+                                                  OngoingExecutionError,
+                                                  ReplanDirective)
 from cruise_control_tpu.executor.strategy import resolve_strategy
 from cruise_control_tpu.model.stats import compute_stats
 from cruise_control_tpu.model.tensor_model import BrokerState, TensorClusterModel
@@ -133,7 +135,8 @@ class CruiseControl:
                  self_healing_exclude_recently_demoted: bool = True,
                  self_healing_exclude_recently_removed: bool = True,
                  warm_start_enabled: bool = False,
-                 warm_start_delta_threshold: float = 0.05):
+                 warm_start_delta_threshold: float = 0.05,
+                 replan_interval_polls: int = 0):
         self.load_monitor = load_monitor
         self.executor = executor
         self.admin = admin
@@ -164,6 +167,14 @@ class CruiseControl:
         # are warm even when requests stay cold.
         self._warm_start_enabled = warm_start_enabled
         self._warm_delta_threshold = warm_start_delta_threshold
+        # execution.replan.interval.polls: 0 (default) executes plans
+        # statically; N > 0 re-solves against the partially-moved cluster
+        # every N executor polls and patches the live task queue.
+        self._replan_interval_polls = replan_interval_polls
+        # The run whose converged model the LAST successful mid-execution
+        # replan targeted — what _absorb_execution should re-base onto
+        # instead of the original run when a replanned execution lands ok.
+        self._executed_run_override: Optional[opt.OptimizerRun] = None
         self._cache_lock = threading.Lock()
         # The STANDING PROPOSAL: (model_generation, monotonic time,
         # pre-optimization model, converged run, renumbered proposals).
@@ -343,11 +354,16 @@ class CruiseControl:
             # Live broker health feeds the ConcurrencyAdjuster during the
             # wait loop (Executor.java:335-447 reads request-queue depth /
             # handler idle ratio each interval).
+            self._executed_run_override = None
+            replanner = (self._make_replanner(run, naming)
+                         if self._replan_interval_polls > 0 else None)
             execution = self.executor.execute_proposals(
                 proposals, naming["partitions"],
                 concurrency_adjust_metrics=self.load_monitor.broker_health_metrics,
                 strategy=strategy, replication_throttle=replication_throttle,
-                balancedness_scorer=scorer)
+                balancedness_scorer=scorer,
+                replanner=replanner,
+                replan_interval_polls=self._replan_interval_polls)
             ok = execution.ok
         return OperationResult(
             ok=ok, dryrun=dryrun, proposals=proposals,
@@ -359,6 +375,56 @@ class CruiseControl:
             execution=execution, reason=reason, capped_goals=capped,
             balancedness_before=run.balancedness_before,
             balancedness_after=run.balancedness_after)
+
+    def _make_replanner(self, run: opt.OptimizerRun,
+                        naming: Dict[str, object]):
+        """Build the executor's replan-while-executing hook.
+
+        Called at phase boundaries (where ``score_checkpoints`` already
+        dispatches) with the ledger's landed/in-flight partition sets; the
+        fresh load-monitor model IS the partially-moved blend — landed
+        moves are in the cluster metadata, so the warm re-solve (seeded
+        from the previous converged placement, frontier = the delta the
+        execution + churn created) targets exactly the remaining work.
+        Returns ``None`` on any soundness failure (membership/naming
+        drift, incompatible delta, verification failure) — the executor
+        counts a fallback and keeps the current plan."""
+        state = {"run": run}
+
+        def replanner(landed: frozenset, inflight: frozenset
+                      ) -> Optional[ReplanDirective]:
+            fresh, naming2 = self._model_naming()
+            if (list(naming2["brokers"]) != list(naming["brokers"])
+                    or list(naming2["partitions"]) != list(naming["partitions"])):
+                # Mid-execution membership/naming drift: task partition ids
+                # would no longer address the same partitions — keep the
+                # static plan and let an anomaly path deal with it.
+                return None
+            crun = state["run"]
+            delta = model_delta(crun.model, fresh)
+            if delta is None:
+                return None
+            goal_names = [g.name for g in crun.goal_results]
+            run2 = self._optimize(
+                fresh, goal_names, naming=naming2,
+                warm_start=WarmStart(prev_model=crun.model,
+                                     active_mask=delta.changed_mask))
+            dense = props.diff(fresh, run2.model)
+            try:
+                verify_run(fresh, run2, goal_names,
+                           constraint=self.constraint, proposals=dense)
+            except VerificationError:
+                return None
+            proposals = props.renumber_brokers(dense, naming2["brokers"])
+            scorer = opt.PlacementScorer.for_run(
+                fresh, run2, self.constraint, *self._balancedness_weights)
+            state["run"] = run2
+            self._executed_run_override = run2
+            return ReplanDirective(
+                proposals=proposals, scorer=scorer,
+                info={"landed": len(landed), "inflight": len(inflight)})
+
+        return replanner
 
     # ------------------------------------------------------------------
     # Standing proposal (cruise mode / warm start)
@@ -396,8 +462,15 @@ class CruiseControl:
         execution absorbs nothing: the placement is then neither the old
         baseline nor the converged model, and the ordinary delta probe is
         the honest path."""
+        override = self._executed_run_override
+        self._executed_run_override = None
         if execution is None or not getattr(execution, "ok", False):
             return
+        if override is not None:
+            # The execution was replanned mid-flight: the placement that
+            # actually landed is the LAST re-solve's converged model, not
+            # the original run's.
+            run = override
         gen = self.load_monitor.model_generation().as_tuple()
         with self._cache_lock:
             self._cached = (gen, time.monotonic(), run.model, run, [])

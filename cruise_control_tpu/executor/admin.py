@@ -22,6 +22,12 @@ from cruise_control_tpu.monitor.metadata import (BrokerInfo, ClusterMetadata,
 Tp = Tuple[str, int]
 
 
+class TransientAdminError(RuntimeError):
+    """A retryable admin-layer failure (network blip, controller handover,
+    request timeout).  The executor's retry/backoff envelope retries these;
+    anything else propagates."""
+
+
 @dataclasses.dataclass
 class ReassignmentRequest:
     tp: Tp
@@ -88,6 +94,12 @@ class InMemoryClusterAdmin(ClusterAdmin):
         self.throttle_history: List[Dict[str, object]] = []
         # broker → {logdir → online}; tests flip entries to simulate disk death.
         self.logdir_health: Dict[int, Dict[str, bool]] = {}
+
+    @property
+    def metadata_client(self) -> MetadataClient:
+        """The metadata backend this admin mutates (resume harnesses build
+        a fresh Executor against the same admin + metadata pair)."""
+        return self._md
 
     # -- reassignment ------------------------------------------------------
     def alter_partition_reassignments(self, requests: Sequence[ReassignmentRequest]) -> None:

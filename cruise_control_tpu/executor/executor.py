@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import os
 import threading
 import time
 from contextlib import contextmanager
@@ -29,7 +30,12 @@ from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 from cruise_control_tpu.analyzer.proposals import ExecutionProposal
 from cruise_control_tpu.common.sensors import SENSORS
 from cruise_control_tpu.common.tracing import TRACE
-from cruise_control_tpu.executor.admin import ClusterAdmin, ReassignmentRequest, Tp
+from cruise_control_tpu.executor.admin import (ClusterAdmin,
+                                               ReassignmentRequest,
+                                               TransientAdminError, Tp)
+from cruise_control_tpu.executor.journal import (ExecutionJournal,
+                                                 JournalError, ResumeState,
+                                                 rebuild as rebuild_journal)
 from cruise_control_tpu.executor.ledger import ExecutionLedger
 from cruise_control_tpu.executor.planner import ExecutionPlan, ExecutionTaskPlanner
 from cruise_control_tpu.executor.strategy import ReplicaMovementStrategy, StrategyContext
@@ -53,6 +59,36 @@ class ExecutorState(enum.Enum):
 
 class OngoingExecutionError(RuntimeError):
     pass
+
+
+class SimulatedCrash(RuntimeError):
+    """Raised by the ``crash_after_polls`` fault hook: models a process
+    death mid-execution.  The journal (if enabled) is left exactly as a
+    real kill would leave it; ``Executor.resume()`` picks it up."""
+
+
+def replan_enabled() -> bool:
+    """CRUISE_REPLAN=0 kill-switch for replan-while-executing."""
+    return os.environ.get("CRUISE_REPLAN", "1").lower() not in (
+        "0", "false", "no", "off")
+
+
+@dataclasses.dataclass
+class ReplanDirective:
+    """What a replanner callback hands back to the executor at a phase
+    boundary: the re-solved proposal set for the partially-moved cluster
+    (the executor patches the live queue against it: cancel-what-changed,
+    keep-what-still-helps) plus an optional replacement ``PlacementScorer``
+    whose before/after match the new plan."""
+
+    proposals: List[ExecutionProposal]
+    scorer: object = None
+    info: Optional[Dict[str, object]] = None
+
+
+#: Replanner signature: (landed_partitions, in_flight_partitions) →
+#: ReplanDirective, or None to keep the current (static) plan.
+Replanner = Callable[[frozenset, frozenset], Optional[ReplanDirective]]
 
 
 @dataclasses.dataclass
@@ -131,7 +167,11 @@ class Executor:
                  concurrency_adjuster_min_per_broker: int = 1,
                  concurrency_adjuster_max_per_broker: Optional[int] = None,
                  ledger_enabled: bool = True,
-                 clock_ms: Optional[Callable[[], int]] = None):
+                 clock_ms: Optional[Callable[[], int]] = None,
+                 admin_max_retries: int = 3,
+                 admin_retry_backoff_s: float = 0.05,
+                 breaker_failure_threshold: int = 3,
+                 breaker_cooldown_ms: int = 60_000):
         self._admin = admin
         self._metadata = metadata_client
         self._limits = limits or ConcurrencyLimits()
@@ -170,6 +210,14 @@ class Executor:
         self._ledger_enabled = ledger_enabled
         self._clock_ms = clock_ms or (lambda: int(time.time() * 1000))
         self._ledger: Optional[ExecutionLedger] = None
+        # Fault-tolerant dispatch: retry/backoff envelope around admin
+        # calls + per-broker circuit breaker (broker → [consecutive
+        # failures, open-until clock]).
+        self._admin_max_retries = max(0, admin_max_retries)
+        self._admin_retry_backoff_s = max(0.0, admin_retry_backoff_s)
+        self._breaker_threshold = max(1, breaker_failure_threshold)
+        self._breaker_cooldown_ms = max(0, breaker_cooldown_ms)
+        self._breaker: Dict[int, List[float]] = {}
         # Sensor registrations (Executor.registerGaugeSensors,
         # Executor.java:271; Sensors.md execution gauges).
         from cruise_control_tpu.executor.task import TaskType as _TT
@@ -256,6 +304,59 @@ class Executor:
             SENSORS.histogram(
                 "Executor.task-duration-seconds", labels={"type": tt.value},
                 help="Completed execution task duration, by task type")
+
+        # Interruptible-execution families: live replanning, crash resume,
+        # and the admin retry/backoff + circuit-breaker envelope.
+        self._sensor_replan = {
+            "rounds": SENSORS.counter(
+                "Executor.replan-rounds",
+                help="Replan-while-executing rounds that produced a patch"),
+            "cancelled": SENSORS.counter(
+                "Executor.replan-tasks-cancelled",
+                help="Pending tasks cancelled because the re-solve changed "
+                     "their target"),
+            "kept": SENSORS.counter(
+                "Executor.replan-tasks-kept",
+                help="Pending tasks kept verbatim across a replan round"),
+            "added": SENSORS.counter(
+                "Executor.replan-tasks-added",
+                help="Tasks added by replan rounds for newly-needed moves"),
+            "fallbacks": SENSORS.counter(
+                "Executor.replan-fallbacks",
+                help="Replan rounds that kept the static plan (replanner "
+                     "declined, failed verification, or raised)"),
+        }
+        self._sensor_resume_started = SENSORS.counter(
+            "Executor.resume-started",
+            help="Journal resumes attempted")
+        self._sensor_resume_completed = SENSORS.counter(
+            "Executor.resume-completed",
+            help="Journal resumes that reconstructed state and re-entered "
+                 "the phase loop")
+        self._sensor_resume_adopted = SENSORS.counter(
+            "Executor.resume-tasks-adopted",
+            help="In-flight tasks adopted from the journal on resume")
+        self._sensor_resume_errors = SENSORS.counter(
+            "Executor.resume-journal-errors",
+            help="Resumes that hit a corrupt journal and fell back to a "
+                 "clean abort")
+        self._sensor_retries = SENSORS.counter(
+            "Executor.admin-retries",
+            help="Transient admin failures retried with exponential backoff")
+        self._sensor_retry_giveups = SENSORS.counter(
+            "Executor.admin-retry-giveups",
+            help="Admin calls abandoned after exhausting the retry budget "
+                 "(their tasks abort and await replan)")
+        self._sensor_breaker_opens = SENSORS.counter(
+            "Executor.admin-breaker-opens",
+            help="Per-broker circuit-breaker trips after consecutive admin "
+                 "failures")
+        SENSORS.gauge(
+            "Executor.admin-breaker-open-brokers",
+            lambda: float(sum(
+                1 for st in self._breaker.values()
+                if st[1] > self._clock_ms())),
+            help="Brokers whose admin circuit is currently open")
 
     # -- state -------------------------------------------------------------
     def state(self) -> ExecutorState:
@@ -372,7 +473,8 @@ class Executor:
 
     @contextmanager
     def _phase_probe(self, phase: str, tasks: int,
-                     ledger: Optional[ExecutionLedger] = None):
+                     ledger: Optional[ExecutionLedger] = None,
+                     journal: Optional[ExecutionJournal] = None):
         """Span + duration histogram around one execution phase.  Yields the
         span so the phase runner can annotate polls/batches/bytes onto it."""
         hist = SENSORS.histogram(
@@ -380,8 +482,63 @@ class Executor:
             help="Wall time spent in each execution phase")
         if ledger is not None:
             ledger.phase_started(phase)
+        if journal is not None:
+            journal.phase(phase, self._clock_ms())
         with TRACE.span(f"executor.{phase}", tasks=tasks) as sp, hist.time():
             yield sp
+
+    # -- fault-tolerant dispatch (retry/backoff + per-broker breaker) --------
+    def _circuit_open(self, brokers, now_ms: int) -> bool:
+        """True when any involved broker's admin circuit is open.  An
+        elapsed cooldown resets the entry (half-open: the next call gets a
+        fresh retry budget)."""
+        for b in brokers:
+            st = self._breaker.get(b)
+            if st is None:
+                continue
+            if st[1] > now_ms:
+                return True
+            if st[1]:
+                self._breaker.pop(b, None)
+        return False
+
+    def _record_admin_failure(self, brokers) -> None:
+        now = self._clock_ms()
+        for b in brokers:
+            st = self._breaker.setdefault(b, [0, 0])
+            st[0] += 1
+            if st[0] >= self._breaker_threshold and st[1] <= now:
+                st[1] = now + self._breaker_cooldown_ms
+                self._sensor_breaker_opens.inc()
+
+    def _record_admin_success(self, brokers) -> None:
+        for b in brokers:
+            self._breaker.pop(b, None)
+
+    def _call_admin(self, fn: Callable[[], None], brokers) -> bool:
+        """Retry/timeout envelope around one ClusterAdmin call: transient
+        failures retry with exponential backoff; exhausting the budget
+        records a per-broker failure (tripping the circuit breaker at the
+        threshold) and returns False so the caller aborts the affected
+        tasks instead of wedging the phase loop."""
+        delay = self._admin_retry_backoff_s
+        attempts = self._admin_max_retries
+        while True:
+            try:
+                fn()
+            except TransientAdminError:
+                if attempts <= 0:
+                    self._sensor_retry_giveups.inc()
+                    self._record_admin_failure(brokers)
+                    return False
+                attempts -= 1
+                self._sensor_retries.inc()
+                if delay:
+                    time.sleep(delay)
+                    delay *= 2
+                continue
+            self._record_admin_success(brokers)
+            return True
 
     # -- main entry ----------------------------------------------------------
     def execute_proposals(self, proposals: Sequence[ExecutionProposal],
@@ -393,7 +550,11 @@ class Executor:
                               Callable[[], Dict[int, Dict[str, float]]]] = None,
                           strategy: Optional[ReplicaMovementStrategy] = None,
                           replication_throttle: Optional[int] = None,
-                          balancedness_scorer=None
+                          balancedness_scorer=None,
+                          replanner: Optional[Replanner] = None,
+                          replan_interval_polls: int = 0,
+                          journal_path: Optional[str] = None,
+                          crash_after_polls: Optional[int] = None
                           ) -> ExecutionResult:
         """Run the full three-phase execution to completion.
 
@@ -408,9 +569,22 @@ class Executor:
         ``balancedness_scorer`` (a ``PlacementScorer``) attaches goal-distance
         re-scoring to the ledger's checkpoints — batched at phase boundaries,
         never per poll.
+
+        Interruptible execution: ``journal_path`` appends the in-flight plan
+        + every transition to a sidecar JSONL file (flushed once per ledger
+        poll, host-side only) so :meth:`resume` can continue after a crash.
+        ``replanner`` + ``replan_interval_polls`` N re-solve against the
+        partially-moved cluster every N polls (at the same boundaries
+        ``score_checkpoints`` dispatches) and patch the live queue —
+        cancel-what-changed, keep-what-still-helps; the ``CRUISE_REPLAN=0``
+        env kill-switch disables it.  ``crash_after_polls`` is the fault
+        hook: raise :class:`SimulatedCrash` once the ledger's cumulative
+        poll count reaches the given value (tests/bench kill-resume legs).
         """
         if poll_interval_s is None:
             poll_interval_s = self._progress_check_interval_s
+        if journal_path is not None and not self._ledger_enabled:
+            raise ValueError("journaling requires ledger_enabled=True")
         with self._lock:
             if self.has_ongoing_execution:
                 raise OngoingExecutionError("an execution is already in progress")
@@ -427,13 +601,14 @@ class Executor:
         if self._on_pause:
             self._on_pause("ongoing execution")
         try:
-            planner = ExecutionTaskPlanner(
-                strategy if strategy is not None else self._strategy)
+            effective_strategy = strategy if strategy is not None else self._strategy
+            planner = ExecutionTaskPlanner(effective_strategy)
             throttle = (ReplicationThrottleHelper(self._admin, replication_throttle)
                         if replication_throttle is not None else self._throttle)
             plan = planner.plan(proposals, context)
             tm = ExecutionTaskManager(plan, self._limits)
             ledger: Optional[ExecutionLedger] = None
+            journal: Optional[ExecutionJournal] = None
             if self._ledger_enabled:
                 rate = (replication_throttle if replication_throttle is not None
                         else self._throttle.rate_bytes_per_sec)
@@ -441,57 +616,166 @@ class Executor:
                                          throttle_rate_bytes_per_sec=rate,
                                          scorer=balancedness_scorer)
                 ledger.attach(plan)
+                if journal_path is not None:
+                    journal = ExecutionJournal(journal_path)
+                    journal.start(plan, partition_names, tm.limits, max_polls,
+                                  replication_throttle, ledger.started_ms)
+                    ledger.set_event_sink(journal.event)
             with self._lock:
                 self._task_manager = tm
                 self._ledger = ledger
-            polls = 0
-            stopped = False
+            ctx = _ExecutionCtx(
+                plan=plan, tm=tm, ledger=ledger, journal=journal,
+                throttle=throttle, partition_names=partition_names,
+                max_polls=max_polls, poll_interval_s=poll_interval_s,
+                metrics_fn=concurrency_adjust_metrics,
+                strategy=effective_strategy, replanner=replanner,
+                replan_interval_polls=replan_interval_polls,
+                crash_after_polls=crash_after_polls)
+            return self._drive(ctx, n_proposals=len(proposals))
+        finally:
+            with self._lock:
+                self._state = ExecutorState.NO_TASK_IN_PROGRESS
+            if self._on_resume:
+                self._on_resume()
 
-            with TRACE.span("executor.execute", proposals=len(proposals),
+    def resume(self, journal_path: str,
+               balancedness_scorer=None,
+               poll_interval_s: Optional[float] = None,
+               concurrency_adjust_metrics: Optional[
+                   Callable[[], Dict[int, Dict[str, float]]]] = None,
+               replanner: Optional[Replanner] = None,
+               replan_interval_polls: int = 0,
+               max_polls: Optional[int] = None,
+               crash_after_polls: Optional[int] = None) -> ExecutionResult:
+        """Continue a journaled execution after a crash or stop.
+
+        Replays the journal into a fresh plan/task-manager/ledger (see
+        :mod:`cruise_control_tpu.executor.journal`), adopts the tasks that
+        were in flight at the kill point (their reassignments persist in
+        the cluster), and re-enters the phase loop mid-phase; completed
+        phases are skipped.  The final placement and ledger totals are
+        bit-identical to an uninterrupted run.
+
+        A corrupt journal falls back to a clean abort: ongoing
+        reassignments are cancelled, ``ongoing_execution`` is cleared, and
+        the :class:`JournalError` propagates.
+        """
+        if poll_interval_s is None:
+            poll_interval_s = self._progress_check_interval_s
+        with self._lock:
+            if self.has_ongoing_execution:
+                raise OngoingExecutionError("an execution is already in progress")
+            self._state = ExecutorState.STARTING_EXECUTION
+            self._stop_requested = False
+            self._force_stop = False
+            self._reserved_for_proposals = False
+        self._sensor_resume_started.inc()
+        try:
+            st = rebuild_journal(journal_path, scorer=balancedness_scorer)
+        except JournalError:
+            self._sensor_resume_errors.inc()
+            # Clean abort: drop orphaned reassignments, clear state, let the
+            # caller see the corruption.
+            self._admin.cancel_reassignments()
+            with self._lock:
+                self._state = ExecutorState.NO_TASK_IN_PROGRESS
+            raise
+        journal = ExecutionJournal(journal_path, append=True)
+        st.ledger.set_clock(self._clock_ms)
+        st.ledger.set_event_sink(journal.event)
+        throttle = (ReplicationThrottleHelper(self._admin,
+                                              st.replication_throttle)
+                    if st.replication_throttle is not None else self._throttle)
+        with self._lock:
+            self._task_manager = st.task_manager
+            self._ledger = st.ledger
+        self._sensor_resume_adopted.inc(len(st.in_flight))
+        self._sensor_resume_completed.inc()
+        if self._on_pause:
+            self._on_pause("resumed execution")
+        try:
+            ctx = _ExecutionCtx(
+                plan=st.plan, tm=st.task_manager, ledger=st.ledger,
+                journal=journal, throttle=throttle,
+                partition_names=st.partition_names,
+                max_polls=(max_polls if max_polls is not None
+                           else st.max_polls),
+                poll_interval_s=poll_interval_s,
+                metrics_fn=concurrency_adjust_metrics,
+                strategy=self._strategy, replanner=replanner,
+                replan_interval_polls=replan_interval_polls,
+                crash_after_polls=crash_after_polls)
+            return self._drive(ctx, n_proposals=st.plan.total_tasks,
+                               done_phases=st.done_phases,
+                               adopted=st.in_flight, polls_start=st.polls)
+        finally:
+            with self._lock:
+                self._state = ExecutorState.NO_TASK_IN_PROGRESS
+            if self._on_resume:
+                self._on_resume()
+
+    # -- the shared phase driver ---------------------------------------------
+    def _drive(self, ctx: "_ExecutionCtx", n_proposals: int,
+               done_phases: frozenset = frozenset(),
+               adopted: Optional[Dict[int, ExecutionTask]] = None,
+               polls_start: int = 0) -> ExecutionResult:
+        plan, tm, ledger, journal = ctx.plan, ctx.tm, ctx.ledger, ctx.journal
+        partition_names = ctx.partition_names
+        polls = polls_start
+        stopped = False
+        try:
+            with TRACE.span("executor.execute", proposals=n_proposals,
                             inter_broker_tasks=len(plan.inter_broker_tasks),
                             intra_broker_tasks=len(plan.intra_broker_tasks),
-                            leadership_tasks=len(plan.leadership_tasks)) as sp:
+                            leadership_tasks=len(plan.leadership_tasks),
+                            resumed=bool(polls_start)) as sp:
                 # Phase 1: inter-broker replica movement (throttled).
-                if plan.inter_broker_tasks and not stopped:
+                if plan.inter_broker_tasks and "inter_broker" not in done_phases:
                     with self._lock:
                         self._state = ExecutorState.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
-                    involved = sorted({b for t in plan.inter_broker_tasks
-                                       for b in t.brokers_involved()})
-                    throttle.set_throttles(plan.inter_broker_tasks, partition_names)
+                    ctx.throttle.set_throttles(plan.inter_broker_tasks,
+                                               partition_names)
                     try:
                         with self._phase_probe("inter_broker",
                                                len(plan.inter_broker_tasks),
-                                               ledger) as psp:
-                            polls, stopped = self._run_inter_broker_phase(
-                                tm, partition_names, max_polls, poll_interval_s,
-                                concurrency_adjust_metrics, ledger, psp)
+                                               ledger, journal) as psp:
+                            phase_polls, stopped = self._run_inter_broker_phase(
+                                ctx, psp, adopted=adopted,
+                                polls_budget=max(1, ctx.max_polls - polls_start))
+                            polls += phase_polls
                     finally:
-                        throttle.clear_throttles(plan.inter_broker_tasks,
-                                                 partition_names)
+                        ctx.throttle.clear_throttles(plan.inter_broker_tasks,
+                                                     partition_names)
                     if ledger is not None:
                         ledger.score_checkpoints()
 
                 # Phase 2: intra-broker (logdir) movement.
-                if plan.intra_broker_tasks and not stopped and not self._stop_requested:
+                if plan.intra_broker_tasks and "intra_broker" not in done_phases \
+                        and not stopped and not self._stop_requested:
                     with self._lock:
                         self._state = ExecutorState.INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
                     with self._phase_probe("intra_broker",
                                            len(plan.intra_broker_tasks),
-                                           ledger) as psp:
-                        self._run_intra_broker_phase(tm, partition_names,
-                                                     ledger, psp)
+                                           ledger, journal) as psp:
+                        self._run_intra_broker_phase(ctx, psp)
 
                 # Phase 3: leadership movement (batched preferred elections).
-                if plan.leadership_tasks and not stopped and not self._stop_requested:
+                if plan.leadership_tasks and "leadership" not in done_phases \
+                        and not stopped and not self._stop_requested:
                     with self._lock:
                         self._state = ExecutorState.LEADER_MOVEMENT_TASK_IN_PROGRESS
                     with self._phase_probe("leadership",
                                            len(plan.leadership_tasks),
-                                           ledger) as psp:
-                        self._run_leadership_phase(tm, partition_names, max_polls,
-                                                   poll_interval_s, ledger, psp)
+                                           ledger, journal) as psp:
+                        self._run_leadership_phase(ctx, psp, adopted=adopted)
 
                 stopped = stopped or self._stop_requested
+                if stopped and self._force_stop:
+                    # Terminal-ize everything through the ledger observer so
+                    # gauges and the curve reflect the abort instead of
+                    # counting dead work as in-flight/pending forever.
+                    self._finalize_force_stop(plan, tm)
                 buckets = tm.tasks_by_state()
                 if stopped:
                     self._sensor_stopped.inc()
@@ -506,23 +790,61 @@ class Executor:
                 sp.annotate(completed=len(buckets[TaskState.COMPLETED]),
                             dead=len(buckets[TaskState.DEAD]),
                             stopped=stopped, polls=polls)
+                if journal is not None:
+                    journal.close()
                 return ExecutionResult(
                     completed=len(buckets[TaskState.COMPLETED]),
                     dead=len(buckets[TaskState.DEAD]),
                     aborted=len(buckets[TaskState.ABORTED]),
                     polls=polls, stopped=stopped)
-        finally:
-            with self._lock:
-                self._state = ExecutorState.NO_TASK_IN_PROGRESS
-            if self._on_resume:
-                self._on_resume()
+        except SimulatedCrash:
+            # A process death runs no finalization: the journal stays torn
+            # at its last flushed poll line and the admin keeps its
+            # in-flight reassignments — exactly what resume() expects.
+            if journal is not None:
+                journal.close()
+            raise
+
+    def _finalize_force_stop(self, plan: ExecutionPlan,
+                             tm: ExecutionTaskManager) -> None:
+        """Force-stop epilogue: every non-terminal task reaches a terminal
+        state through its observer (in-flight → ABORTING → ABORTED, pending
+        → cancelled), releasing in-flight accounting so ``Executor.*``
+        gauges and the time-to-balanced curve record the abort."""
+        now = self._clock_ms()
+        for t in (plan.inter_broker_tasks + plan.intra_broker_tasks
+                  + plan.leadership_tasks):
+            if t.state == TaskState.IN_PROGRESS:
+                t.aborting(now)
+                t.aborted(now)
+                tm.finished(t)
+            elif t.state == TaskState.ABORTING:
+                t.aborted(now)
+                tm.finished(t)
+            elif t.state == TaskState.PENDING:
+                t.cancel(now)
+                tm.finished(t)
 
     # -- phases --------------------------------------------------------------
     def _target_replicas(self, task: ExecutionTask) -> Tuple[int, ...]:
         return tuple(r.broker for r in task.proposal.new_replicas)
 
+    def _poll_tick(self, ctx: "_ExecutionCtx") -> None:
+        """One ledger poll + journal flush + crash fault hook (the journal
+        write serializes host-side Python state only — no device fetch)."""
+        if ctx.ledger is None:
+            return
+        ctx.ledger.poll(ctx.tm)
+        if ctx.journal is not None:
+            ctx.journal.poll(self._clock_ms())
+        if ctx.crash_after_polls is not None \
+                and ctx.ledger.polls >= ctx.crash_after_polls:
+            raise SimulatedCrash(
+                f"injected crash at ledger poll {ctx.ledger.polls}")
+
     def _adjust_concurrency(self, tm: ExecutionTaskManager, metrics_fn,
-                            ledger: Optional[ExecutionLedger]) -> None:
+                            ledger: Optional[ExecutionLedger],
+                            journal: Optional[ExecutionJournal] = None) -> None:
         """One adjuster evaluation; classifies the decision (halve / double /
         hold) by comparing the per-broker limit before and after, since the
         adjuster itself is interval-gated and may return the input."""
@@ -536,15 +858,97 @@ class Executor:
         self._sensor_adjuster[decision].inc()
         if ledger is not None:
             ledger.adjuster_decision(decision)
+        if decision != "hold" and journal is not None:
+            journal.limits(tm.limits)
 
-    def _run_inter_broker_phase(self, tm: ExecutionTaskManager,
-                                partition_names: Sequence[Tp], max_polls: int,
-                                poll_interval_s: float, metrics_fn,
-                                ledger: Optional[ExecutionLedger] = None,
-                                span=None) -> Tuple[int, bool]:
-        submitted: Dict[int, ExecutionTask] = {}
+    # -- replan-while-executing ----------------------------------------------
+    def _replan_round(self, ctx: "_ExecutionCtx",
+                      submitted: Dict[int, ExecutionTask]) -> None:
+        """One phase-boundary replan: score the curve (the same boundary
+        where ``score_checkpoints`` dispatches), hand the landed/in-flight
+        partition sets to the replanner, and patch the live queue against
+        the directive — cancel-what-changed, keep-what-still-helps, add
+        what's newly needed.  Any failure keeps the static plan."""
+        ledger = ctx.ledger
+        if ledger is not None:
+            ledger.score_checkpoints()
+        landed = frozenset(ledger._landed) if ledger is not None else frozenset()
+        inflight = frozenset(t.proposal.partition for t in submitted.values())
+        try:
+            directive = ctx.replanner(landed, inflight)
+        except Exception:
+            self._sensor_replan["fallbacks"].inc()
+            return
+        if directive is None or directive.proposals is None:
+            self._sensor_replan["fallbacks"].inc()
+            return
+
+        now = self._clock_ms()
+        new_by_part = {p.partition: p for p in directive.proposals}
+        all_tasks = (ctx.plan.inter_broker_tasks + ctx.plan.intra_broker_tasks
+                     + ctx.plan.leadership_tasks)
+        pending_by_part: Dict[int, List[ExecutionTask]] = {}
+        for t in all_tasks:
+            if t.state == TaskState.PENDING:
+                pending_by_part.setdefault(t.proposal.partition, []).append(t)
+        cancelled = kept = 0
+        covered = set()
+        for part, tasks in pending_by_part.items():
+            np_ = new_by_part.get(part)
+            if np_ is not None and np_.new_replicas == tasks[0].proposal.new_replicas:
+                kept += len(tasks)
+                covered.add(part)
+            else:
+                for t in tasks:
+                    t.cancel(now)
+                    ctx.tm.finished(t)
+                    cancelled += 1
+        add_props = [p for part, p in new_by_part.items()
+                     if part not in covered and part not in inflight]
+        added_tasks: List[ExecutionTask] = []
+        if add_props:
+            next_id = max((t.execution_id for t in all_tasks), default=-1) + 1
+            planner = ExecutionTaskPlanner(ctx.strategy,
+                                           first_execution_id=next_id)
+            addition = planner.plan(add_props, None)
+            added_tasks = (addition.inter_broker_tasks
+                           + addition.intra_broker_tasks
+                           + addition.leadership_tasks)
+            ctx.plan.inter_broker_tasks.extend(addition.inter_broker_tasks)
+            ctx.plan.intra_broker_tasks.extend(addition.intra_broker_tasks)
+            ctx.plan.leadership_tasks.extend(addition.leadership_tasks)
+            for b, ts in addition.tasks_by_broker.items():
+                ctx.plan.tasks_by_broker.setdefault(b, []).extend(ts)
+        if ledger is not None:
+            ledger.replan_rebase(added_tasks, cancelled, kept,
+                                 scorer=directive.scorer)
+        if ctx.journal is not None:
+            ctx.journal.replan(added_tasks, cancelled, kept, now)
+        self._sensor_replan["rounds"].inc()
+        self._sensor_replan["cancelled"].inc(cancelled)
+        self._sensor_replan["kept"].inc(kept)
+        self._sensor_replan["added"].inc(len(added_tasks))
+        TRACE.annotate(replan_cancelled=cancelled, replan_kept=kept,
+                       replan_added=len(added_tasks))
+
+    def _run_inter_broker_phase(self, ctx: "_ExecutionCtx", span=None,
+                                adopted: Optional[Dict[int, ExecutionTask]] = None,
+                                polls_budget: Optional[int] = None
+                                ) -> Tuple[int, bool]:
+        tm, ledger, journal = ctx.tm, ctx.ledger, ctx.journal
+        partition_names = ctx.partition_names
+        max_polls = polls_budget if polls_budget is not None else ctx.max_polls
+        # Resume path: adopt the tasks that were in flight at the crash —
+        # their reassignments persist in the cluster, so the ordinary
+        # completion checks below pick them up.
+        submitted: Dict[int, ExecutionTask] = {
+            eid: t for eid, t in (adopted or {}).items()
+            if t.task_type == TaskType.INTER_BROKER_REPLICA_ACTION}
         polls = 0
         batches = 0
+        can_replan = (ctx.replanner is not None
+                      and ctx.replan_interval_polls > 0 and replan_enabled())
+        crashed = False
         try:
             while polls < max_polls:
                 if self._stop_requested:
@@ -563,15 +967,39 @@ class Executor:
                     new_tasks = tm.next_inter_broker_tasks()
                     if new_tasks:
                         batches += 1
-                        reqs = []
                         now = self._clock_ms()
+                        runnable: List[ExecutionTask] = []
                         for t in new_tasks:
-                            t.in_progress(now)
-                            submitted[t.execution_id] = t
-                            reqs.append(ReassignmentRequest(
-                                tp=partition_names[t.proposal.partition],
-                                new_replicas=self._target_replicas(t)))
-                        self._admin.alter_partition_reassignments(reqs)
+                            if self._circuit_open(t.brokers_involved(), now):
+                                # Circuit open on a destination: abort the
+                                # task now (a later replan round re-plans
+                                # the partition) instead of wedging.
+                                t.cancel(now)
+                                tm.finished(t)
+                            else:
+                                runnable.append(t)
+                        if runnable:
+                            reqs = []
+                            for t in runnable:
+                                t.in_progress(now)
+                                reqs.append(ReassignmentRequest(
+                                    tp=partition_names[t.proposal.partition],
+                                    new_replicas=self._target_replicas(t)))
+                            batch_brokers = {b for t in runnable
+                                             for b in t.brokers_involved()}
+                            if self._call_admin(
+                                    lambda: self._admin.alter_partition_reassignments(reqs),
+                                    batch_brokers):
+                                for t in runnable:
+                                    submitted[t.execution_id] = t
+                                if journal is not None:
+                                    journal.flush()
+                            else:
+                                now2 = self._clock_ms()
+                                for t in runnable:
+                                    t.aborting(now2)
+                                    t.aborted(now2)
+                                    tm.finished(t)
 
                 ongoing = self._admin.ongoing_reassignments()
                 cluster = self._metadata.cluster()
@@ -594,110 +1022,193 @@ class Executor:
                             self._admin.cancel_reassignments([tp])
                             del submitted[t.execution_id]
                 polls += 1
-                if ledger is not None:
-                    ledger.poll(tm)
-                if metrics_fn is not None and self._adjuster_enabled:
-                    self._adjust_concurrency(tm, metrics_fn, ledger)
+                self._poll_tick(ctx)
+                if ctx.metrics_fn is not None and self._adjuster_enabled:
+                    self._adjust_concurrency(tm, ctx.metrics_fn, ledger, journal)
+                if can_replan and polls % ctx.replan_interval_polls == 0 \
+                        and not self._stop_requested:
+                    self._replan_round(ctx, submitted)
                 if not submitted:
-                    pending = [t for t in tm._plan.inter_broker_tasks
+                    pending = [t for t in ctx.plan.inter_broker_tasks
                                if t.state == TaskState.PENDING]
                     if not pending or self._stop_requested:
                         return polls, False
-                if poll_interval_s:
-                    time.sleep(poll_interval_s)
+                if ctx.poll_interval_s:
+                    time.sleep(ctx.poll_interval_s)
             return polls, True
+        except SimulatedCrash:
+            crashed = True
+            raise
         finally:
-            if ledger is not None:
-                ledger.phase_finished(polls=polls, batches=batches)
-            if span is not None:
-                span.annotate(polls=polls, batches=batches)
+            # A (simulated) process death runs no phase finalization.
+            if not crashed:
                 if ledger is not None:
-                    span.annotate(bytes_moved=ledger.bytes_moved)
+                    ledger.phase_finished(polls=polls, batches=batches)
+                if journal is not None:
+                    journal.phase_end("inter_broker", self._clock_ms(),
+                                      polls, batches)
+                if span is not None:
+                    span.annotate(polls=polls, batches=batches)
+                    if ledger is not None:
+                        span.annotate(bytes_moved=ledger.bytes_moved)
 
-    def _run_intra_broker_phase(self, tm: ExecutionTaskManager,
-                                partition_names: Sequence[Tp],
-                                ledger: Optional[ExecutionLedger] = None,
-                                span=None) -> None:
+    def _run_intra_broker_phase(self, ctx: "_ExecutionCtx", span=None) -> None:
+        tm, ledger, journal = ctx.tm, ctx.ledger, ctx.journal
+        partition_names = ctx.partition_names
         batches = 0
-        while True:
-            tasks = tm.next_intra_broker_tasks()
-            if not tasks:
-                break
-            batches += 1
-            moves = []
-            now = self._clock_ms()
-            for t in tasks:
-                t.in_progress(now)
-                for broker, _old_disk, new_disk in t.proposal._intra_broker_moves():
-                    logdir = self._logdir_by_disk.get(new_disk, f"/logdir-{new_disk}")
-                    moves.append((partition_names[t.proposal.partition], broker, logdir))
-            self._admin.alter_replica_logdirs(moves)
-            now = self._clock_ms()
-            for t in tasks:
-                t.completed(now)
-                tm.finished(t)
-            if ledger is not None:
-                ledger.poll(tm)
-        if ledger is not None:
-            ledger.phase_finished(batches=batches)
-        if span is not None:
-            span.annotate(batches=batches)
+        crashed = False
+        try:
+            while True:
+                tasks = tm.next_intra_broker_tasks()
+                if not tasks:
+                    break
+                batches += 1
+                moves = []
+                now = self._clock_ms()
+                for t in tasks:
+                    t.in_progress(now)
+                    for broker, _old_disk, new_disk in t.proposal._intra_broker_moves():
+                        logdir = self._logdir_by_disk.get(new_disk, f"/logdir-{new_disk}")
+                        moves.append((partition_names[t.proposal.partition], broker, logdir))
+                batch_brokers = {b for t in tasks for b in t.brokers_involved()}
+                ok = self._call_admin(
+                    lambda: self._admin.alter_replica_logdirs(moves),
+                    batch_brokers)
+                now = self._clock_ms()
+                for t in tasks:
+                    if ok:
+                        t.completed(now)
+                    else:
+                        t.aborting(now)
+                        t.aborted(now)
+                    tm.finished(t)
+                self._poll_tick(ctx)
+        except SimulatedCrash:
+            crashed = True
+            raise
+        finally:
+            if not crashed:
+                if ledger is not None:
+                    ledger.phase_finished(batches=batches)
+                if journal is not None:
+                    journal.phase_end("intra_broker", self._clock_ms(),
+                                      0, batches)
+                if span is not None:
+                    span.annotate(batches=batches)
 
-    def _run_leadership_phase(self, tm: ExecutionTaskManager,
-                              partition_names: Sequence[Tp],
-                              max_polls: int = 10_000,
-                              poll_interval_s: float = 0.0,
-                              ledger: Optional[ExecutionLedger] = None,
-                              span=None) -> None:
+    def _run_leadership_phase(self, ctx: "_ExecutionCtx", span=None,
+                              adopted: Optional[Dict[int, ExecutionTask]] = None
+                              ) -> None:
+        tm, ledger, journal = ctx.tm, ctx.ledger, ctx.journal
+        partition_names = ctx.partition_names
         batches = 0
         total_polls = 0
-        while not self._stop_requested:
-            tasks = tm.next_leadership_tasks()
-            if not tasks:
-                break
-            batches += 1
-            # Make the proposal's leader the preferred replica then trigger a
-            # batched preferred-leader election (moveLeaderships,
-            # Executor.java:1373-1399).
-            reqs = [ReassignmentRequest(tp=partition_names[t.proposal.partition],
-                                        new_replicas=self._target_replicas(t))
-                    for t in tasks]
-            now = self._clock_ms()
-            for t in tasks:
-                t.in_progress(now)
-            self._admin.alter_partition_reassignments(reqs)
-            polls = 0
-            deadline = time.monotonic() + self._leader_movement_timeout_ms / 1000.0
-            while self._admin.ongoing_reassignments() and polls < max_polls \
-                    and not self._force_stop and time.monotonic() < deadline:
-                polls += 1
-                if poll_interval_s:
-                    time.sleep(poll_interval_s)
-            total_polls += polls
-            timed_out = (polls >= max_polls or self._force_stop
-                         or (self._admin.ongoing_reassignments()
-                             and time.monotonic() >= deadline))
-            if not timed_out:
-                self._admin.elect_leaders([partition_names[t.proposal.partition]
-                                           for t in tasks])
-            else:
-                # Don't leave the preferred-order reassignments of killed
-                # tasks in flight (same cleanup as the inter-broker DEAD
-                # path; the reference deletes the reassignment znodes).
-                self._admin.cancel_reassignments(
-                    [partition_names[t.proposal.partition] for t in tasks])
-            now = self._clock_ms()
-            for t in tasks:
-                if timed_out:
-                    t.kill(now)
+        # Resume path: leadership tasks that were in flight at the crash
+        # already have their preferred-order reassignments submitted (or
+        # applied) — drive them through the wait/elect cycle WITHOUT
+        # re-submitting.
+        carried = [t for t in (adopted or {}).values()
+                   if t.task_type == TaskType.LEADER_ACTION
+                   and t.state == TaskState.IN_PROGRESS]
+        crashed = False
+        try:
+            while not self._stop_requested:
+                resubmit = not carried
+                if carried:
+                    tasks, carried = carried, []
                 else:
-                    t.completed(now)
-                tm.finished(t)
-            if ledger is not None:
-                ledger.poll(tm)
-            if timed_out:
-                break
-        if ledger is not None:
-            ledger.phase_finished(polls=total_polls, batches=batches)
-        if span is not None:
-            span.annotate(polls=total_polls, batches=batches)
+                    tasks = tm.next_leadership_tasks()
+                    if not tasks:
+                        break
+                batches += 1
+                # Make the proposal's leader the preferred replica then trigger a
+                # batched preferred-leader election (moveLeaderships,
+                # Executor.java:1373-1399).
+                now = self._clock_ms()
+                if resubmit:
+                    reqs = [ReassignmentRequest(
+                        tp=partition_names[t.proposal.partition],
+                        new_replicas=self._target_replicas(t))
+                        for t in tasks]
+                    for t in tasks:
+                        t.in_progress(now)
+                    batch_brokers = {b for t in tasks
+                                     for b in t.brokers_involved()}
+                    if not self._call_admin(
+                            lambda: self._admin.alter_partition_reassignments(reqs),
+                            batch_brokers):
+                        now2 = self._clock_ms()
+                        for t in tasks:
+                            t.aborting(now2)
+                            t.aborted(now2)
+                            tm.finished(t)
+                        self._poll_tick(ctx)
+                        continue
+                    if journal is not None:
+                        journal.flush()
+                polls = 0
+                deadline = time.monotonic() + self._leader_movement_timeout_ms / 1000.0
+                while self._admin.ongoing_reassignments() and polls < ctx.max_polls \
+                        and not self._force_stop and time.monotonic() < deadline:
+                    polls += 1
+                    if ctx.poll_interval_s:
+                        time.sleep(ctx.poll_interval_s)
+                total_polls += polls
+                timed_out = (polls >= ctx.max_polls or self._force_stop
+                             or (self._admin.ongoing_reassignments()
+                                 and time.monotonic() >= deadline))
+                if not timed_out:
+                    self._call_admin(
+                        lambda: self._admin.elect_leaders(
+                            [partition_names[t.proposal.partition]
+                             for t in tasks]),
+                        {b for t in tasks for b in t.brokers_involved()})
+                else:
+                    # Don't leave the preferred-order reassignments of killed
+                    # tasks in flight (same cleanup as the inter-broker DEAD
+                    # path; the reference deletes the reassignment znodes).
+                    self._admin.cancel_reassignments(
+                        [partition_names[t.proposal.partition] for t in tasks])
+                now = self._clock_ms()
+                for t in tasks:
+                    if timed_out:
+                        t.kill(now)
+                    else:
+                        t.completed(now)
+                    tm.finished(t)
+                self._poll_tick(ctx)
+                if timed_out:
+                    break
+        except SimulatedCrash:
+            crashed = True
+            raise
+        finally:
+            if not crashed:
+                if ledger is not None:
+                    ledger.phase_finished(polls=total_polls, batches=batches)
+                if journal is not None:
+                    journal.phase_end("leadership", self._clock_ms(),
+                                      total_polls, batches)
+                if span is not None:
+                    span.annotate(polls=total_polls, batches=batches)
+
+
+@dataclasses.dataclass
+class _ExecutionCtx:
+    """Everything one execution's phase loop threads through — built once
+    by ``execute_proposals`` (fresh run) or ``resume`` (journal replay),
+    consumed by ``_drive`` and the phase runners."""
+
+    plan: ExecutionPlan
+    tm: ExecutionTaskManager
+    ledger: Optional[ExecutionLedger]
+    journal: Optional[ExecutionJournal]
+    throttle: ReplicationThrottleHelper
+    partition_names: Sequence[Tp]
+    max_polls: int
+    poll_interval_s: float
+    metrics_fn: Optional[Callable[[], Dict[int, Dict[str, float]]]]
+    strategy: Optional[ReplicaMovementStrategy]
+    replanner: Optional[Replanner]
+    replan_interval_polls: int
+    crash_after_polls: Optional[int]

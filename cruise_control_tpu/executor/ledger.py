@@ -43,17 +43,22 @@ MAX_EVENTS_IN_DUMP = 2048
 
 class ExecutionLedger:
     def __init__(self, clock_ms, throttle_rate_bytes_per_sec: Optional[int] = None,
-                 scorer=None, max_checkpoints: int = MAX_CHECKPOINTS):
+                 scorer=None, max_checkpoints: int = MAX_CHECKPOINTS,
+                 event_sink=None):
         self._clock_ms = clock_ms
         self._throttle_rate = throttle_rate_bytes_per_sec
         self._scorer = scorer
         self._max_checkpoints = max(8, max_checkpoints)
         self._stride = 1          # checkpoint every Nth eligible poll
         self._polls_since_checkpoint = 0
+        # Optional pass-through of every task transition (the execution
+        # journal's hook; None costs nothing).
+        self._event_sink = event_sink
 
         self.events: List[dict] = []
         self.checkpoints: List[dict] = []
         self.phases: List[dict] = []
+        self.replans: List[dict] = []
         self.adjuster_decisions: Dict[str, int] = {
             "halve": 0, "double": 0, "hold": 0}
         self.task_durations_ms: Dict[str, List[int]] = {
@@ -76,6 +81,10 @@ class ExecutionLedger:
         self._outstanding_by_partition: Dict[int, int] = {}
         self._landed: set = set()
         self._stuck: set = set()
+        # Partitions whose task was cancelled before it started (replan /
+        # force-stop): they never moved, so draining their outstanding count
+        # must not land them at the "after" placement.
+        self._cancelled: set = set()
 
     # -- wiring --------------------------------------------------------------
     def attach(self, plan) -> None:
@@ -92,6 +101,46 @@ class ExecutionLedger:
             p = t.proposal.partition
             self._outstanding_by_partition[p] = \
                 self._outstanding_by_partition.get(p, 0) + 1
+
+    def set_event_sink(self, sink) -> None:
+        """Attach/detach the per-transition pass-through (journal hook)."""
+        self._event_sink = sink
+
+    def set_clock(self, clock_ms) -> None:
+        """Swap the clock source (resume replaces the journal-replay clock
+        with the executor's live clock once replay is done)."""
+        self._clock_ms = clock_ms
+
+    def set_scorer(self, scorer) -> None:
+        """Swap the balancedness scorer.  Replan rebasing swaps in a scorer
+        whose "before" is the partially-moved cluster and whose "after" is
+        the re-solved target, so post-replan checkpoints score against the
+        plan actually being executed."""
+        self._scorer = scorer
+
+    def replan_rebase(self, added_tasks, cancelled: int, kept: int,
+                      scorer=None) -> None:
+        """Rebase the ledger on a live replan: hook the added tasks, grow
+        the totals, and re-dirty their partitions (a landed/stuck/cancelled
+        partition that the new plan moves again is live work once more).
+        Cancellations arrive separately through observe() as
+        PENDING→ABORTED transitions."""
+        self.replans.append({"tMs": self._clock_ms(), "poll": self.polls,
+                             "cancelled": cancelled, "kept": kept,
+                             "added": len(added_tasks)})
+        if scorer is not None:
+            self._scorer = scorer
+        for t in added_tasks:
+            t.observer = self.observe
+            self.counts[t.state.value] += 1
+            self.total_tasks += 1
+            self.total_bytes += t.bytes_to_move
+            p = t.proposal.partition
+            self._outstanding_by_partition[p] = max(
+                0, self._outstanding_by_partition.get(p, 0)) + 1
+            self._landed.discard(p)
+            self._stuck.discard(p)
+            self._cancelled.discard(p)
 
     # -- event intake --------------------------------------------------------
     def observe(self, task: ExecutionTask, old_state: TaskState,
@@ -114,20 +163,31 @@ class ExecutionLedger:
             ).observe(max(0, task.end_time_ms - task.start_time_ms) / 1000.0)
             self._land(task.proposal.partition)
         elif new_state in (TaskState.ABORTED, TaskState.DEAD):
-            # ABORTING→ABORTED: in-flight bytes were added at IN_PROGRESS
-            # and not yet released (ABORTING releases nothing).
-            self.bytes_in_flight -= b
-            self._stuck.add(task.proposal.partition)
+            if old_state in (TaskState.IN_PROGRESS, TaskState.ABORTING):
+                # ABORTING→ABORTED: in-flight bytes were added at IN_PROGRESS
+                # and not yet released (ABORTING releases nothing).
+                self.bytes_in_flight -= b
+                self._stuck.add(task.proposal.partition)
+            else:
+                # PENDING→ABORTED cancellation: the task never carried
+                # in-flight bytes and its work leaves the plan entirely —
+                # shrink the plan total so offTargetBytes still converges.
+                self.total_bytes -= b
+                self._cancelled.add(task.proposal.partition)
+                self._land(task.proposal.partition)
         self.events.append({
             "id": task.execution_id, "type": task.task_type.value,
             "partition": task.proposal.partition,
             "from": old_state.value, "to": new_state.value,
             "tMs": now_ms, "bytes": b})
+        if self._event_sink is not None:
+            self._event_sink(task, old_state, new_state, now_ms)
 
     def _land(self, partition: int) -> None:
         n = self._outstanding_by_partition.get(partition, 0) - 1
         self._outstanding_by_partition[partition] = n
-        if n <= 0 and partition not in self._stuck:
+        if n <= 0 and partition not in self._stuck \
+                and partition not in self._cancelled:
             self._landed.add(partition)
 
     def adjuster_decision(self, decision: str) -> None:
@@ -289,6 +349,7 @@ class ExecutionLedger:
             "landedPartitions": len(self._landed),
             "balancedness": self.balancedness,
             "phases": [dict(p) for p in self.phases],
+            "replans": [dict(r) for r in self.replans],
             "taskDurations": self._duration_summary(),
         }
         if verbose:

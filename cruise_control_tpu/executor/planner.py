@@ -42,9 +42,12 @@ class ExecutionPlan:
 
 
 class ExecutionTaskPlanner:
-    def __init__(self, strategy: Optional[ReplicaMovementStrategy] = None):
+    def __init__(self, strategy: Optional[ReplicaMovementStrategy] = None,
+                 first_execution_id: int = 0):
+        # ``first_execution_id`` lets a mid-execution replan mint task ids
+        # that continue after the live plan's current maximum.
         self._strategy = strategy or BaseReplicaMovementStrategy()
-        self._next_execution_id = 0
+        self._next_execution_id = first_execution_id
 
     def _new_task(self, proposal: ExecutionProposal, task_type: TaskType) -> ExecutionTask:
         t = ExecutionTask(self._next_execution_id, proposal, task_type)

@@ -40,7 +40,11 @@ class TaskState(enum.Enum):
 
 
 _VALID_TRANSITIONS = {
-    TaskState.PENDING: {TaskState.IN_PROGRESS},
+    # PENDING → ABORTED is the cancellation edge: a task dropped before
+    # submission (replan cancel-what-changed, force-stop finalization, or a
+    # circuit-broken destination).  It never carried in-flight bytes, which
+    # the ledger's observe() distinguishes by the old state.
+    TaskState.PENDING: {TaskState.IN_PROGRESS, TaskState.ABORTED},
     TaskState.IN_PROGRESS: {TaskState.ABORTING, TaskState.DEAD, TaskState.COMPLETED},
     TaskState.ABORTING: {TaskState.ABORTED, TaskState.DEAD},
     TaskState.ABORTED: set(),
@@ -89,6 +93,10 @@ class ExecutionTask:
         self._transition(TaskState.ABORTING, now_ms)
 
     def aborted(self, now_ms: Optional[int] = None) -> None:
+        self._transition(TaskState.ABORTED, now_ms)
+
+    def cancel(self, now_ms: Optional[int] = None) -> None:
+        """Abort a task that never started (PENDING → ABORTED)."""
         self._transition(TaskState.ABORTED, now_ms)
 
     def kill(self, now_ms: Optional[int] = None) -> None:

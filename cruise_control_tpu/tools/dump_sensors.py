@@ -174,6 +174,34 @@ def exercise(api, mgr) -> None:
     model = api.cc.load_monitor.cluster_model()
     proposals = sim.sample_move_proposals(model, moves=2, leadership=1)
     sim.run_simulated_execution(model, proposals, tick_ms=200)
+    # Interruptible-execution families: one journaled run against a chaos
+    # admin (seeded transient failures drive the Executor.admin-retry
+    # envelope), patched by a keep-everything replan round
+    # (Executor.replan-*), killed mid-phase and resumed from the journal
+    # (Executor.resume-*) — so every family the interruptible executor owns
+    # carries exercised values, not just eager-registration zeros.
+    import tempfile
+
+    from cruise_control_tpu.executor.executor import (ReplanDirective,
+                                                      SimulatedCrash)
+    jp = os.path.join(tempfile.gettempdir(), "_cc_dump_sensors.journal")
+    ex2, _admin2, pnames, _ = sim.build_simulated_execution(
+        model, proposals, tick_ms=200, rate_bytes_per_sec=1_000_000.0,
+        faults=sim.FaultInjection(transient_failure_rate=0.3, seed=5))
+    try:
+        ex2.execute_proposals(
+            proposals, pnames, poll_interval_s=0.0,
+            journal_path=jp, crash_after_polls=2,
+            replanner=lambda landed, inflight: ReplanDirective(list(proposals)),
+            replan_interval_polls=1)
+        print("warning: interruptible exercise completed before the "
+              "simulated crash", file=sys.stderr)
+    except SimulatedCrash:
+        ex2.resume(jp, poll_interval_s=0.0)
+    try:
+        os.remove(jp)
+    except OSError:
+        pass
     # Inter-goal pipelining families: the 5-broker stack sits far below
     # the auto-pipeline floor, so one explicitly pipelined pass registers
     # GoalOptimizer.goals-overlapped / goals-fused / pipeline-fill-ratio /

@@ -214,3 +214,37 @@ def test_execution_report_reads_bench_artifact():
     assert rep["balancedness_converged"] is True
     assert rep["total_bytes"] == 100
     assert rep["wall_to_balanced_s"] == 2.0
+
+
+def test_execution_report_replan_markers(capsys):
+    """A REPLAN artifact's live-replan points surface in the report and
+    interleave with the curve by ledger poll count."""
+    sys.path.insert(0, str(REPO))
+    from tools.execution_report import build_report, print_report
+    artifact = {
+        "metric": "replan_time_to_balanced_mid",
+        "curve": [
+            {"tMs": 0, "poll": 1, "bytesMoved": 0, "offTargetBytes": 100,
+             "balancedness": 10.0},
+            {"tMs": 2000, "poll": 9, "bytesMoved": 60, "offTargetBytes": 40,
+             "balancedness": 55.0},
+            {"tMs": 4000, "poll": 17, "bytesMoved": 100, "offTargetBytes": 0,
+             "balancedness": 98.0},
+        ],
+        "plan": {"totalTasks": 3, "totalBytes": 100},
+        "result": {"completed": 3, "dead": 0, "aborted": 0},
+        "replans": [{"tMs": 1500, "poll": 5, "cancelled": 2, "kept": 7,
+                     "added": 1}],
+        "balancedness_final": 98.0,
+    }
+    rep = build_report(artifact)
+    assert rep["replan_count"] == 1
+    assert rep["replans"][0]["cancelled"] == 2
+    print_report(rep)
+    lines = capsys.readouterr().out.splitlines()
+    marker = next(i for i, l in enumerate(lines)
+                  if "replan @poll 5" in l)
+    assert "cancelled=2" in lines[marker] and "kept=7" in lines[marker]
+    # The marker sits between the poll-1 and poll-9 curve rows.
+    assert any("0.0" in l for l in lines[:marker])
+    assert any("replans: 1" in l for l in lines)

@@ -1,0 +1,445 @@
+"""Interruptible-execution tests: crash-exact resume from the journal,
+replan-while-executing queue patching, the admin retry/backoff envelope with
+per-broker circuit breaking, fault injection, and the force-stop abort fix.
+"""
+
+import json
+import os
+
+import pytest
+
+from cruise_control_tpu.analyzer.proposals import (ExecutionProposal,
+                                                   ReplicaPlacement)
+from cruise_control_tpu.common.sensors import SENSORS
+from cruise_control_tpu.executor import simulate as sim
+from cruise_control_tpu.executor.admin import (InMemoryClusterAdmin,
+                                               TransientAdminError)
+from cruise_control_tpu.executor.executor import (Executor, ReplanDirective,
+                                                  SimulatedCrash,
+                                                  replan_enabled)
+from cruise_control_tpu.executor.journal import (JournalError,
+                                                 proposal_from_json,
+                                                 proposal_to_json,
+                                                 rebuild)
+from cruise_control_tpu.executor.simulate import (ChaosClusterAdmin,
+                                                  FaultInjection)
+from cruise_control_tpu.executor.task import TaskState
+from cruise_control_tpu.executor.task_manager import ConcurrencyLimits
+from tests.test_executor import build_cluster, monitored
+
+RATE = 10_000_000.0
+
+
+def _model(seed=3):
+    _, lm = monitored(build_cluster(seed=seed))
+    return lm.cluster_model()
+
+
+def _placement_signature(admin):
+    return sorted((p.tp, p.leader, tuple(sorted(p.replicas)))
+                  for p in admin.metadata_client.cluster().partitions)
+
+
+def _run_with_journal(model, proposals, journal_path, **kw):
+    return sim.run_simulated_execution(
+        model, proposals, tick_ms=500, rate_bytes_per_sec=RATE,
+        adjuster_churn=False, journal_path=journal_path, **kw)
+
+
+# -- journal round trip -------------------------------------------------------
+
+def test_proposal_json_round_trip():
+    p = ExecutionProposal(
+        partition=7, topic=2, partition_size=123.5,
+        old_leader=ReplicaPlacement(0, 1),
+        old_replicas=(ReplicaPlacement(0, 1), ReplicaPlacement(3)),
+        new_replicas=(ReplicaPlacement(2), ReplicaPlacement(3)))
+    assert proposal_from_json(json.loads(
+        json.dumps(proposal_to_json(p)))) == p
+
+
+def test_crash_resume_bit_identity_every_phase(tmp_path):
+    """Kill the executor at polls landing in the inter-broker and leadership
+    phases (plus mid-inter), resume from the journal, and pin the final
+    placement + ledger totals bit-identical to an uninterrupted run."""
+    model = _model()
+    proposals = sim.sample_move_proposals(model, moves=3, leadership=2)
+    assert proposals
+
+    ref_jp = str(tmp_path / "ref.journal")
+    r_ref, ex_ref, ad_ref = _run_with_journal(model, proposals, ref_jp)
+    assert r_ref.ok
+    ref_sig = _placement_signature(ad_ref)
+    ref_prog = ex_ref.progress(verbose=True)
+    inter_polls = next(ph["polls"] for ph in ref_prog["phases"]
+                       if ph["phase"] == "inter_broker")
+    assert inter_polls > 4
+
+    # Crash points: early inter, late inter, first leadership batch.
+    for crash_at in (2, inter_polls - 1, inter_polls + 1):
+        jp = str(tmp_path / f"crash{crash_at}.journal")
+        ex, admin, pnames, _ = sim.build_simulated_execution(
+            model, proposals, tick_ms=500, rate_bytes_per_sec=RATE)
+        with pytest.raises(SimulatedCrash):
+            ex.execute_proposals(
+                proposals, pnames, max_polls=200_000, poll_interval_s=0.0,
+                replication_throttle=int(RATE),
+                journal_path=jp, crash_after_polls=crash_at)
+        assert not ex.has_ongoing_execution
+        result = ex.resume(jp, poll_interval_s=0.0)
+        assert result.ok
+        assert result.completed == r_ref.completed
+        assert _placement_signature(admin) == ref_sig
+        prog = ex.progress(verbose=True)
+        for key in ("taskCounts", "totalTasks", "totalBytes", "bytesMoved",
+                    "bytesInFlight"):
+            assert prog[key] == ref_prog[key], (crash_at, key)
+        if crash_at < inter_polls:
+            # Mid-phase kill: the resumed curve (incl. stride-thinned
+            # checkpoints) and finish clock match exactly.
+            assert prog["checkpoints"] == ref_prog["checkpoints"]
+            assert prog["finishedMs"] == ref_prog["finishedMs"]
+
+
+def test_crash_resume_intra_broker_phase(tmp_path):
+    """Crash inside the intra-broker (logdir) phase and resume."""
+    md = build_cluster()
+    names = [p.tp for p in md.partitions]
+    from cruise_control_tpu.monitor.metadata import MetadataClient
+    mc = MetadataClient(md)
+    admin = InMemoryClusterAdmin(mc)
+
+    def intra(pid, parts):
+        o = tuple(ReplicaPlacement(b, 0) for b in parts[pid].replicas)
+        n = (ReplicaPlacement(o[0].broker, 1),) + o[1:]
+        return ExecutionProposal(partition=pid, topic=0, partition_size=5.0,
+                                 old_leader=o[0], old_replicas=o,
+                                 new_replicas=n)
+
+    proposals = [intra(i, md.partitions) for i in range(3)]
+    limits = ConcurrencyLimits(intra_broker_per_broker=1)
+    clock = {"t": 0}
+
+    def tick():
+        clock["t"] += 100
+        return clock["t"]
+
+    jp = "/tmp/_cc_intra.journal"
+    ex = Executor(admin, mc, limits=limits, clock_ms=tick,
+                  ledger_enabled=True, admin_retry_backoff_s=0.0)
+    with pytest.raises(SimulatedCrash):
+        ex.execute_proposals(proposals, names, poll_interval_s=0.0,
+                             journal_path=jp, crash_after_polls=2)
+    st = rebuild(jp)
+    assert st.current_phase == "intra_broker"
+    result = ex.resume(jp, poll_interval_s=0.0)
+    assert result.ok and result.completed == 3
+    # Every logdir move landed exactly once across crash + resume.
+    assert len(admin.logdir_moves) == 3
+    os.remove(jp)
+
+
+def test_corrupt_and_truncated_journal(tmp_path):
+    model = _model()
+    proposals = sim.sample_move_proposals(model, moves=2, leadership=1)
+
+    # Corrupt header → JournalError → clean abort with state cleared.
+    bad = tmp_path / "bad.journal"
+    bad.write_text('{"kind":"poll","tMs":1}\n')
+    ex, admin, pnames, _ = sim.build_simulated_execution(
+        model, proposals, tick_ms=500, rate_bytes_per_sec=RATE)
+    with pytest.raises(JournalError):
+        ex.resume(str(bad))
+    assert not ex.has_ongoing_execution
+    assert ex.progress()["state"] == "no_task_in_progress"
+
+    # Mid-file garbage → JournalError.
+    jp = tmp_path / "mid.journal"
+    ex2, admin2, pnames2, _ = sim.build_simulated_execution(
+        model, proposals, tick_ms=500, rate_bytes_per_sec=RATE)
+    with pytest.raises(SimulatedCrash):
+        ex2.execute_proposals(proposals, pnames2, poll_interval_s=0.0,
+                              replication_throttle=int(RATE),
+                              journal_path=str(jp), crash_after_polls=3)
+    lines = jp.read_text().splitlines()
+    garbled = lines[:2] + ["NOT JSON"] + lines[2:]
+    jp.write_text("\n".join(garbled) + "\n")
+    with pytest.raises(JournalError):
+        ex2.resume(str(jp))
+    assert not ex2.has_ongoing_execution
+    # Ongoing reassignments were cancelled by the clean abort.
+    assert not admin2._inflight
+
+    # A TORN final line is the normal crash artifact, not corruption.
+    jp2 = tmp_path / "torn.journal"
+    ex3, admin3, pnames3, _ = sim.build_simulated_execution(
+        model, proposals, tick_ms=500, rate_bytes_per_sec=RATE)
+    with pytest.raises(SimulatedCrash):
+        ex3.execute_proposals(proposals, pnames3, poll_interval_s=0.0,
+                              replication_throttle=int(RATE),
+                              journal_path=str(jp2), crash_after_polls=3)
+    jp2.write_text(jp2.read_text() + '{"kind":"poll","tM')
+    assert ex3.resume(str(jp2), poll_interval_s=0.0).ok
+
+
+# -- force-stop ----------------------------------------------------------------
+
+def test_force_stop_aborts_through_ledger():
+    """stop_execution(force=True) must terminal-ize every task through the
+    ledger observer: nothing stays pending/in-flight, bytes_in_flight drains
+    to zero, and the curve records the abort (regression: dead tasks used to
+    count as in-flight forever)."""
+    model = _model()
+    proposals = sim.sample_move_proposals(model, moves=3, leadership=2)
+    ex, admin, pnames, _ = sim.build_simulated_execution(
+        model, proposals, tick_ms=500, rate_bytes_per_sec=RATE,
+        limits=ConcurrencyLimits(inter_broker_per_broker=1,
+                                 max_cluster_partition_movements=1))
+    calls = {"n": 0}
+
+    def metrics():
+        calls["n"] += 1
+        if calls["n"] == 3:
+            ex.stop_execution(force=True)
+        return {0: {"BROKER_REQUEST_QUEUE_SIZE": 1.0,
+                    "BROKER_REQUEST_HANDLER_POOL_IDLE_PERCENT": 0.9}}
+
+    result = ex.execute_proposals(
+        proposals, pnames, poll_interval_s=0.0,
+        replication_throttle=int(RATE), concurrency_adjust_metrics=metrics)
+    assert result.stopped
+    prog = ex.progress(verbose=True)
+    counts = prog["taskCounts"]
+    assert counts["pending"] == 0
+    assert counts["in_progress"] == 0
+    assert counts["aborting"] == 0
+    assert counts["aborted"] > 0
+    assert prog["bytesInFlight"] == 0
+    assert prog["finishedMs"] is not None
+    assert result.aborted == counts["aborted"]
+    # The cluster holds no orphaned reassignments.
+    assert not admin._inflight
+
+
+# -- replan-while-executing ----------------------------------------------------
+
+def _trickle_rig(model, proposals):
+    """One-at-a-time admission so pending tasks exist at replan time."""
+    return sim.build_simulated_execution(
+        model, proposals, tick_ms=500, rate_bytes_per_sec=RATE,
+        limits=ConcurrencyLimits(inter_broker_per_broker=1,
+                                 max_cluster_partition_movements=1))
+
+
+def test_replan_patches_live_queue():
+    """At the replan boundary: a pending task whose partition keeps its
+    target survives (kept), a pending task the directive drops or retargets
+    is cancelled PENDING→ABORTED, and new proposals are appended with fresh
+    execution ids."""
+    model = _model()
+    proposals = sim.sample_move_proposals(model, moves=3, leadership=0)
+    assert len(proposals) == 3
+    ex, admin, pnames, _ = _trickle_rig(model, proposals)
+    rounds = {"n": 0}
+
+    def replanner(landed, inflight):
+        rounds["n"] += 1
+        if rounds["n"] > 1:
+            return None  # later rounds: keep plan (counts a fallback)
+        keep = [p for p in proposals
+                if p.partition not in landed and p.partition not in inflight]
+        assert keep, "trickle admission should leave pending work"
+        dropped = keep[0]
+        kept = keep[1:]
+        return ReplanDirective(proposals=list(kept))
+
+    result = ex.execute_proposals(
+        proposals, pnames, poll_interval_s=0.0,
+        replication_throttle=int(RATE),
+        replanner=replanner, replan_interval_polls=2)
+    assert rounds["n"] >= 1
+    assert result.stopped is False
+    prog = ex.progress(verbose=True)
+    assert prog["replans"], "ledger must record the replan round"
+    rp = prog["replans"][0]
+    assert rp["cancelled"] == 1 and rp["kept"] >= 1
+    # Dropped partition's task was cancelled without ever moving bytes;
+    # totals shrank so bytesMoved reconciles with totalBytes.
+    assert prog["taskCounts"]["aborted"] == 1
+    assert prog["bytesMoved"] == prog["totalBytes"]
+    assert result.completed == len(proposals) - 1
+
+
+def test_replan_adds_tasks_with_fresh_ids():
+    model = _model()
+    proposals = sim.sample_move_proposals(model, moves=4, leadership=0)
+    first_two, extra = proposals[:2], proposals[2:]
+    ex, admin, pnames, _ = _trickle_rig(model, proposals)
+    fired = {"n": 0}
+
+    def replanner(landed, inflight):
+        fired["n"] += 1
+        if fired["n"] > 1:
+            return None
+        live = [p for p in first_two
+                if p.partition not in landed and p.partition not in inflight]
+        return ReplanDirective(proposals=live + extra)
+
+    result = ex.execute_proposals(
+        first_two, pnames, poll_interval_s=0.0,
+        replication_throttle=int(RATE),
+        replanner=replanner, replan_interval_polls=2)
+    assert result.ok
+    prog = ex.progress(verbose=True)
+    assert prog["replans"][0]["added"] == len(extra)
+    assert result.completed == len(first_two) + len(extra)
+    # Added tasks continue the id sequence past the original plan's.
+    tm = ex._task_manager
+    ids = sorted(t.execution_id for t in tm._plan.inter_broker_tasks)
+    assert ids == list(range(len(ids)))
+
+
+def test_replan_kill_switch(monkeypatch):
+    monkeypatch.setenv("CRUISE_REPLAN", "0")
+    assert not replan_enabled()
+    model = _model()
+    proposals = sim.sample_move_proposals(model, moves=2, leadership=0)
+    ex, admin, pnames, _ = _trickle_rig(model, proposals)
+    called = {"n": 0}
+
+    def replanner(landed, inflight):
+        called["n"] += 1
+        return None
+
+    result = ex.execute_proposals(
+        proposals, pnames, poll_interval_s=0.0,
+        replication_throttle=int(RATE),
+        replanner=replanner, replan_interval_polls=1)
+    assert result.ok
+    assert called["n"] == 0, "CRUISE_REPLAN=0 must disable replan rounds"
+    monkeypatch.setenv("CRUISE_REPLAN", "1")
+    assert replan_enabled()
+
+
+def test_replan_fallback_on_exception():
+    """A replanner that raises keeps the static plan (fallback counter)."""
+    model = _model()
+    proposals = sim.sample_move_proposals(model, moves=2, leadership=0)
+    ex, admin, pnames, _ = _trickle_rig(model, proposals)
+    before = SENSORS.counter("Executor.replan-fallbacks").count
+
+    def replanner(landed, inflight):
+        raise RuntimeError("resolver exploded")
+
+    result = ex.execute_proposals(
+        proposals, pnames, poll_interval_s=0.0,
+        replication_throttle=int(RATE),
+        replanner=replanner, replan_interval_polls=2)
+    assert result.ok and result.completed == len(proposals)
+    assert SENSORS.counter("Executor.replan-fallbacks").count > before
+
+
+# -- retry / backoff / circuit breaker ----------------------------------------
+
+class FlakyAdmin(InMemoryClusterAdmin):
+    """Deterministic: first ``fail_first`` reassignment submissions raise
+    TransientAdminError, then everything succeeds."""
+
+    def __init__(self, mc, fail_first=2):
+        super().__init__(mc)
+        self.fail_first = fail_first
+        self.attempts = 0
+
+    def alter_partition_reassignments(self, requests):
+        self.attempts += 1
+        if self.attempts <= self.fail_first:
+            raise TransientAdminError("blip")
+        super().alter_partition_reassignments(requests)
+
+
+def test_retry_envelope_recovers_transients():
+    md = build_cluster()
+    names = [p.tp for p in md.partitions]
+    from cruise_control_tpu.monitor.metadata import MetadataClient
+    mc = MetadataClient(md)
+    admin = FlakyAdmin(mc, fail_first=2)
+    p0 = md.partitions[0]
+    dest = next(b.broker_id for b in md.brokers
+                if b.broker_id not in p0.replicas)
+    prop = ExecutionProposal(
+        partition=0, topic=0, partition_size=10.0,
+        old_leader=ReplicaPlacement(p0.leader),
+        old_replicas=tuple(ReplicaPlacement(b) for b in p0.replicas),
+        new_replicas=tuple(ReplicaPlacement(b) for b in p0.replicas[:-1]) +
+        (ReplicaPlacement(dest),))
+    before = SENSORS.counter("Executor.admin-retries").count
+    ex = Executor(admin, mc, admin_max_retries=3, admin_retry_backoff_s=0.0)
+    result = ex.execute_proposals([prop], names, poll_interval_s=0.0)
+    assert result.ok and result.completed == 1
+    assert admin.attempts == 3
+    assert SENSORS.counter("Executor.admin-retries").count == before + 2
+
+
+def test_retry_giveup_aborts_and_breaker_opens():
+    """A persistently failing destination broker: the envelope gives up,
+    the batch aborts (not wedging the phase loop), the breaker opens, and
+    later tasks to that broker are cancelled at admission."""
+    model = _model()
+    proposals = sim.sample_move_proposals(model, moves=3, leadership=0)
+    dest = proposals[0].new_replicas[-1].broker
+    hit = sum(1 for p in proposals if p.new_replicas[-1].broker == dest)
+    assert hit >= 1
+    mc, pnames = sim.metadata_from_model(model)
+    admin = ChaosClusterAdmin(
+        mc, sim.proposal_bytes_by_tp(proposals, pnames),
+        tick_ms=500, rate_bytes_per_sec=RATE,
+        faults=FaultInjection(failing_broker=dest))
+    giveups_before = SENSORS.counter("Executor.admin-retry-giveups").count
+    opens_before = SENSORS.counter("Executor.admin-breaker-opens").count
+    ex = Executor(admin, mc, clock_ms=admin.now_ms,
+                  limits=ConcurrencyLimits(inter_broker_per_broker=1,
+                                           max_cluster_partition_movements=1),
+                  admin_max_retries=1, admin_retry_backoff_s=0.0,
+                  breaker_failure_threshold=1, breaker_cooldown_ms=10 ** 9)
+    result = ex.execute_proposals(proposals, pnames, poll_interval_s=0.0,
+                                  replication_throttle=int(RATE))
+    # Not wedged: the run terminates, every task reaching a terminal state;
+    # moves onto the unreachable broker abort instead of spinning.
+    assert result.completed + result.aborted == len(proposals)
+    assert result.aborted >= hit
+    assert SENSORS.counter("Executor.admin-retry-giveups").count \
+        > giveups_before
+    assert SENSORS.counter("Executor.admin-breaker-opens").count \
+        > opens_before
+    assert admin.injected["failing_broker"] >= 1
+
+
+# -- chaos fault injection -----------------------------------------------------
+
+def test_chaos_transient_and_spikes_still_converge():
+    model = _model()
+    proposals = sim.sample_move_proposals(model, moves=3, leadership=1)
+    faults = FaultInjection(transient_failure_rate=0.3,
+                            latency_spike_rate=0.1,
+                            latency_spike_factor=3.0, seed=7)
+    result, ex, admin = sim.run_simulated_execution(
+        model, proposals, tick_ms=500, rate_bytes_per_sec=RATE,
+        adjuster_churn=False, faults=faults)
+    assert result.completed + result.aborted + result.dead == len(
+        ex._task_manager._plan.inter_broker_tasks) + len(
+        ex._task_manager._plan.leadership_tasks)
+    assert admin.injected["transient"] >= 1
+    assert admin.injected["latency_spikes"] >= 1
+
+
+def test_chaos_broker_death_kills_tasks():
+    model = _model()
+    proposals = sim.sample_move_proposals(model, moves=3, leadership=0)
+    dest = proposals[0].new_replicas[-1].broker
+    faults = FaultInjection(broker_death_ms=1000, dead_broker=dest, seed=1)
+    result, ex, admin = sim.run_simulated_execution(
+        model, proposals, tick_ms=500, rate_bytes_per_sec=RATE,
+        adjuster_churn=False, faults=faults)
+    assert admin.injected["broker_deaths"] == 1
+    # Moves destined for the dead broker take the dead-task path.
+    assert result.dead > 0

@@ -6,7 +6,8 @@ turns a ledger dump into something a human (ASCII curve + phase/duration
 rollup) or a later revision (``--json`` one-liner) can read:
 
 - ``python tools/execution_report.py EXEC_mid.json``     render a bench
-  artifact (bench.py --execute)
+  artifact (bench.py --execute; REPLAN_*.json from --replan works too —
+  live replan points render as ``--- replan`` markers on the curve)
 - ``python tools/execution_report.py dump.json``         render a raw ledger
   dump (``GET /executor_state?verbose=true`` body, or
   ``executor.progress(verbose=True)`` saved as JSON)
@@ -45,6 +46,7 @@ def normalize(record: dict) -> dict:
             "wall_to_balanced_s": record.get("wall_to_balanced_s"),
             "proposals_per_sec": record.get("proposals_per_sec"),
             "balancedness_final": record.get("balancedness_final"),
+            "replans": list(record.get("replans", [])),
         }
     if "checkpoints" not in record:
         raise SystemExit(
@@ -64,6 +66,7 @@ def normalize(record: dict) -> dict:
                                if elapsed is not None else None),
         "proposals_per_sec": None,
         "balancedness_final": record.get("balancedness"),
+        "replans": list(record.get("replans", [])),
     }
 
 
@@ -79,6 +82,7 @@ def build_report(record: dict) -> dict:
     n["off_target_monotone"] = all(b <= a for a, b in zip(off, off[1:]))
     n["balancedness_converged"] = (bool(scored)
                                    and scored[-1] >= max(scored) - 1e-9)
+    n["replan_count"] = len(n["replans"])
     return n
 
 
@@ -98,13 +102,28 @@ def print_report(rep: dict) -> None:
               + (f"  ({pps:.1f} proposals/s)" if pps else ""))
     print()
     print(f"{'t(s)':>8} {'moved%':>7} {'balancedness':>12}  progress")
+    # Live replan points interleave with the curve by ledger poll count:
+    # the marker sits before the first checkpoint taken after the re-solve.
+    replans = sorted(rep["replans"], key=lambda r: r.get("poll", 0))
+    ri = 0
     for c in rep["curve"]:
+        while ri < len(replans) and (replans[ri].get("poll", 0)
+                                     <= c.get("poll", float("inf"))):
+            r = replans[ri]
+            print(f"{'---':>8} replan @poll {r.get('poll', '?')}: "
+                  f"cancelled={r.get('cancelled', 0)} "
+                  f"kept={r.get('kept', 0)} added={r.get('added', 0)}")
+            ri += 1
         t = c.get("tMs", 0) / 1000.0
         moved = c.get("bytesMoved", 0)
         pct = 100.0 * moved / total if total else 0.0
         bal = c.get("balancedness")
         bal_s = "-" if bal is None else f"{bal:.2f}"
         print(f"{t:>8.1f} {pct:>6.1f}% {bal_s:>12}  {_bar(moved, total)}")
+    for r in replans[ri:]:
+        print(f"{'---':>8} replan @poll {r.get('poll', '?')}: "
+              f"cancelled={r.get('cancelled', 0)} "
+              f"kept={r.get('kept', 0)} added={r.get('added', 0)}")
     print()
     if rep["phases"]:
         print("phases:")
@@ -123,7 +142,9 @@ def print_report(rep: dict) -> None:
         print(f"adjuster: halve={a.get('halve', 0)} "
               f"double={a.get('double', 0)} hold={a.get('hold', 0)}")
     print(f"off_target_monotone: {rep['off_target_monotone']}  "
-          f"balancedness_converged: {rep['balancedness_converged']}")
+          f"balancedness_converged: {rep['balancedness_converged']}"
+          + (f"  replans: {rep['replan_count']}"
+             if rep["replan_count"] else ""))
 
 
 def main() -> None:
