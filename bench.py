@@ -53,6 +53,7 @@ import argparse
 import json
 import os
 import signal
+import subprocess
 import sys
 import threading
 import time
@@ -1595,6 +1596,26 @@ def main() -> None:
         # line is covered too), then wedge like a hung backend until the
         # harness' TERM (or the total-budget watchdog) arrives.  Exercised
         # by the suite; never set in real runs.
+        #
+        # The lane also runs cruise-lint so lint drift lands in the same
+        # artifact stream as perf drift.  AST-only with a hard subprocess
+        # timeout: the kill-safe contract (wedge tests wait ≤30 s for this
+        # partial record) cannot afford the jaxpr audit's tracing, and the
+        # audit already runs in tier-1.
+        try:
+            out = subprocess.run(
+                [sys.executable, "-m", "tools.lint", "--ast-only", "--json"],
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                capture_output=True, text=True, timeout=20)
+            parsed = json.loads(out.stdout.strip().splitlines()[-1])
+            lint = {"ok": parsed.get("ok", False),
+                    "unsuppressed": parsed.get("unsuppressed", -1),
+                    "suppressed": sum(
+                        parsed.get("suppressed_counts", {}).values()),
+                    "mode": "ast-only"}
+        except Exception as exc:  # noqa: BLE001 — lint must never wedge the lane
+            lint = {"ok": False, "error": f"{type(exc).__name__}: {exc}",
+                    "mode": "ast-only"}
         metric = ("execution_wall_to_balanced_small" if args.execute
                   else "warm_vs_cold_speedup_small" if args.warm
                   else "pipeline_stack_speedup_small" if args.pipeline
@@ -1602,7 +1623,7 @@ def main() -> None:
                   else "replan_time_to_balanced_small" if args.replan
                   else "wall_clock_to_goal_satisfying_proposal_small")
         _record_rung({"metric": metric, "value": 0.0, "unit": "s",
-                      "vs_baseline": 0.0, "selftest": True,
+                      "vs_baseline": 0.0, "selftest": True, "lint": lint,
                       **({"execute": True} if args.execute else {}),
                       **({"warm": True} if args.warm else {}),
                       **({"pipeline": True} if args.pipeline else {}),

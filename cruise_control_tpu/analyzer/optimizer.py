@@ -954,6 +954,10 @@ def _cross_ceiling_k() -> Optional[int]:
     the active ceiling causes is counted by the
     ``GoalOptimizer.compile-ceiling-clamps`` sensor and logged.
     """
+    # _goal_step reads this at trace time via _goal_num_sources; every
+    # program cache that can reach it keys on _cross_ceiling_k() (see
+    # _get_step_fn), so a mid-process flip recompiles, never serves stale.
+    # cruise-lint: disable=trace-purity (static config; keyed into every reachable jit cache)
     raw = os.environ.get("CRUISE_TPU_COMPILE_CEILING", "off").strip().lower()
     if raw in ("", "0", "off", "none", "false"):
         return None
@@ -1237,8 +1241,11 @@ def _get_step_fn(spec: GoalSpec, prev_specs: Tuple[GoalSpec, ...],
                  constraint: BalancingConstraint, num_sources: int, num_dests: int,
                  mesh=None, donate: bool = False):
     oracle = _repair_oracle()
+    # The traced step derives rack-goal batch widths from the compile
+    # ceiling (_goal_num_sources), so the ceiling is part of the program.
+    ceiling = _cross_ceiling_k()
     key = (spec, prev_specs, constraint, num_sources, num_dests, mesh, donate,
-           oracle)
+           oracle, ceiling)
     fn = _step_cache.get(key)
     if fn is None:
         fn = jax.jit(partial(_goal_step, spec=spec, prev_specs=prev_specs,
@@ -1320,8 +1327,9 @@ def _get_fixpoint_fn(spec: GoalSpec, prev_specs: Tuple[GoalSpec, ...],
                      num_dests: int, max_steps: int, mesh=None,
                      donate: bool = False):
     oracle = _repair_oracle()
+    ceiling = _cross_ceiling_k()
     key = (spec, prev_specs, constraint, num_sources, num_dests, max_steps,
-           mesh, donate, oracle)
+           mesh, donate, oracle, ceiling)
     fn = _fixpoint_cache.get(key)
     if fn is None:
         fn = jax.jit(partial(_goal_fixpoint, spec=spec, prev_specs=prev_specs,
@@ -1614,8 +1622,9 @@ def _get_budget_fixpoint_fn(spec: GoalSpec, prev_specs: Tuple[GoalSpec, ...],
                             num_dests: int, mesh=None, donate: bool = False,
                             flight_capacity: int = 0):
     oracle = _repair_oracle()
+    ceiling = _cross_ceiling_k()
     key = (spec, prev_specs, constraint, num_sources, num_dests, mesh, donate,
-           oracle, flight_capacity)
+           oracle, flight_capacity, ceiling)
     fn = _budget_cache.get(key)
     if fn is None:
         fn = jax.jit(partial(_goal_fixpoint_budget, spec=spec,
@@ -2426,8 +2435,9 @@ def _get_stack_fn(specs: Tuple[GoalSpec, ...], constraint: BalancingConstraint,
                   prev_specs: Tuple[GoalSpec, ...] = (), donate: bool = False,
                   flight_capacity: int = 0):
     oracle = _repair_oracle()
+    ceiling = _cross_ceiling_k()
     key = (specs, constraint, num_sources, num_dests, max_steps, mesh,
-           prev_specs, donate, oracle, flight_capacity)
+           prev_specs, donate, oracle, flight_capacity, ceiling)
     fn = _stack_cache.get(key)
     if fn is None:
         fn = jax.jit(partial(_stack_fixpoint, specs=specs, constraint=constraint,

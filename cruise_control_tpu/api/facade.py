@@ -174,7 +174,9 @@ class CruiseControl:
         # The run whose converged model the LAST successful mid-execution
         # replan targeted — what _absorb_execution should re-base onto
         # instead of the original run when a replanned execution lands ok.
-        self._executed_run_override: Optional[opt.OptimizerRun] = None
+        # Written by the executor's replan hook (executor poll thread) and
+        # consumed by _absorb_execution (request thread).
+        self._executed_run_override: Optional[opt.OptimizerRun] = None  # guarded-by: _cache_lock
         self._cache_lock = threading.Lock()
         # The STANDING PROPOSAL: (model_generation, monotonic time,
         # pre-optimization model, converged run, renumbered proposals).
@@ -184,7 +186,7 @@ class CruiseControl:
         # and the run.model is the warm seed.
         self._cached: Optional[Tuple[Tuple[int, int], float,
                                      TensorClusterModel, opt.OptimizerRun,
-                                     List[props.ExecutionProposal]]] = None
+                                     List[props.ExecutionProposal]]] = None  # guarded-by: _cache_lock
 
     # ------------------------------------------------------------------
     # Model + optimization plumbing
@@ -354,7 +356,8 @@ class CruiseControl:
             # Live broker health feeds the ConcurrencyAdjuster during the
             # wait loop (Executor.java:335-447 reads request-queue depth /
             # handler idle ratio each interval).
-            self._executed_run_override = None
+            with self._cache_lock:
+                self._executed_run_override = None
             replanner = (self._make_replanner(run, naming)
                          if self._replan_interval_polls > 0 else None)
             execution = self.executor.execute_proposals(
@@ -419,7 +422,8 @@ class CruiseControl:
             scorer = opt.PlacementScorer.for_run(
                 fresh, run2, self.constraint, *self._balancedness_weights)
             state["run"] = run2
-            self._executed_run_override = run2
+            with self._cache_lock:
+                self._executed_run_override = run2
             return ReplanDirective(
                 proposals=proposals, scorer=scorer,
                 info={"landed": len(landed), "inflight": len(inflight)})
@@ -462,8 +466,9 @@ class CruiseControl:
         execution absorbs nothing: the placement is then neither the old
         baseline nor the converged model, and the ordinary delta probe is
         the honest path."""
-        override = self._executed_run_override
-        self._executed_run_override = None
+        with self._cache_lock:
+            override = self._executed_run_override
+            self._executed_run_override = None
         if execution is None or not getattr(execution, "ok", False):
             return
         if override is not None:

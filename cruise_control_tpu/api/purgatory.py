@@ -44,8 +44,8 @@ class Purgatory:
         # (WebServerConfig): expiry of reviewed requests + a cap on parked
         # pending reviews.
         self._lock = threading.Lock()
-        self._requests: Dict[int, ReviewRequest] = {}
-        self._next_id = 0
+        self._requests: Dict[int, ReviewRequest] = {}  # guarded-by: _lock
+        self._next_id = 0  # guarded-by: _lock
         self._retention_ms = retention_ms
         self._max_requests = max_requests
 
@@ -65,7 +65,7 @@ class Purgatory:
             self._next_id += 1
             return req
 
-    def _gc(self) -> None:
+    def _gc(self) -> None:  # holds-lock: _lock
         now = int(time.time() * 1000)
         for rid in [r for r, req in self._requests.items()
                     if now - req.submitted_ms > self._retention_ms]:

@@ -396,6 +396,10 @@ class KafkaCruiseControlApp:
         # disables.  One thread per configured count (the optimizer itself
         # batches on the accelerator, so extra threads only pipeline model
         # builds).
+        # Cross-thread mutable state these loops touch lives on the facade,
+        # executor and detector manager, where it carries # guarded-by:
+        # annotations (enforced by cruise-lint); the loops themselves share
+        # only this single-flight lock and thread-local state.
         precompute_flight = threading.Lock()
 
         def precompute_loop():

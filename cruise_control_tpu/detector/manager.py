@@ -98,10 +98,10 @@ class AnomalyDetectorManager:
         self._facade = facade
         self._executor_busy = executor_busy or (lambda: False)
         self.state = AnomalyDetectorState(history_size)
-        self._queue: List[_QueueEntry] = []
+        self._queue: List[_QueueEntry] = []  # guarded-by: _lock
         self._lock = threading.RLock()
         # (detector, interval_ms, last_run_ms, is_multi) registered sources.
-        self._detectors: List[List] = []
+        self._detectors: List[List] = []  # guarded-by: _lock
         # Heal-pipeline sensors registered eagerly so the /metrics catalog is
         # deterministic (the per-anomaly-class rate counters stay
         # conditional — documented in prose, not table rows).
@@ -136,7 +136,8 @@ class AnomalyDetectorManager:
 
     def register_detector(self, detector, interval_ms: int) -> None:
         """detector.detect(now_ms) -> Anomaly | list[Anomaly] | None."""
-        self._detectors.append([detector, int(interval_ms), None])
+        with self._lock:
+            self._detectors.append([detector, int(interval_ms), None])
 
     def enqueue(self, anomaly: Anomaly, now_ms: int, not_before_ms: int = 0) -> None:
         with self._lock:
@@ -185,7 +186,7 @@ class AnomalyDetectorManager:
                 heapq.heappush(self._queue, entry)
         return handled
 
-    def _handle(self, anomaly: Anomaly, now_ms: int) -> int:
+    def _handle(self, anomaly: Anomaly, now_ms: int) -> int:  # holds-lock: _lock
         SENSORS.counter(
             f"AnomalyDetector.{type(anomaly).__name__}-rate",
             help="Anomalies of this type handled by the notifier").inc()

@@ -178,18 +178,18 @@ class Executor:
         self._strategy = strategy
         self._throttle = ReplicationThrottleHelper(admin, throttle_rate_bytes_per_sec)
         self._lock = threading.RLock()
-        self._state = ExecutorState.NO_TASK_IN_PROGRESS
-        self._stop_requested = False
-        self._force_stop = False
-        self._reserved_for_proposals = False
+        self._state = ExecutorState.NO_TASK_IN_PROGRESS  # guarded-by: _lock
+        self._stop_requested = False  # guarded-by: _lock
+        self._force_stop = False  # guarded-by: _lock
+        self._reserved_for_proposals = False  # guarded-by: _lock
         self._retention_ms = removed_broker_retention_ms
         # demoted.broker.retention.time.ms may differ from removed
         # (ExecutorConfig: two distinct retention knobs).
         self._demoted_retention_ms = (demoted_broker_retention_ms
                                       if demoted_broker_retention_ms is not None
                                       else removed_broker_retention_ms)
-        self._recently_removed: Dict[int, int] = {}   # broker → time_ms
-        self._recently_demoted: Dict[int, int] = {}
+        self._recently_removed: Dict[int, int] = {}  # broker → time_ms  # guarded-by: _lock
+        self._recently_demoted: Dict[int, int] = {}  # guarded-by: _lock
         self._on_pause = on_sampling_pause
         self._on_resume = on_sampling_resume
         self._logdir_by_disk = logdir_by_disk or {}
@@ -202,14 +202,14 @@ class Executor:
         self._adjuster_args = (concurrency_adjuster_min_per_broker,
                                concurrency_adjuster_max_per_broker,
                                concurrency_adjuster_interval_ms)
-        self._task_manager: Optional[ExecutionTaskManager] = None
+        self._task_manager: Optional[ExecutionTaskManager] = None  # guarded-by: _lock
         self._adjuster = ConcurrencyAdjuster(self._limits, *self._adjuster_args)
         # Execution ledger (per-task lifecycle log + progress accounting).
         # The clock is pluggable so simulated executions record fleet time;
         # the ledger of the latest execution persists for post-run queries.
         self._ledger_enabled = ledger_enabled
         self._clock_ms = clock_ms or (lambda: int(time.time() * 1000))
-        self._ledger: Optional[ExecutionLedger] = None
+        self._ledger: Optional[ExecutionLedger] = None  # guarded-by: _lock
         # Fault-tolerant dispatch: retry/backoff envelope around admin
         # calls + per-broker circuit breaker (broker → [consecutive
         # failures, open-until clock]).
@@ -217,7 +217,7 @@ class Executor:
         self._admin_retry_backoff_s = max(0.0, admin_retry_backoff_s)
         self._breaker_threshold = max(1, breaker_failure_threshold)
         self._breaker_cooldown_ms = max(0, breaker_cooldown_ms)
-        self._breaker: Dict[int, List[float]] = {}
+        self._breaker: Dict[int, List[float]] = {}  # guarded-by: _lock
         # Sensor registrations (Executor.registerGaugeSensors,
         # Executor.java:271; Sensors.md execution gauges).
         from cruise_control_tpu.executor.task import TaskType as _TT
@@ -492,28 +492,31 @@ class Executor:
         """True when any involved broker's admin circuit is open.  An
         elapsed cooldown resets the entry (half-open: the next call gets a
         fresh retry budget)."""
-        for b in brokers:
-            st = self._breaker.get(b)
-            if st is None:
-                continue
-            if st[1] > now_ms:
-                return True
-            if st[1]:
-                self._breaker.pop(b, None)
+        with self._lock:
+            for b in brokers:
+                st = self._breaker.get(b)
+                if st is None:
+                    continue
+                if st[1] > now_ms:
+                    return True
+                if st[1]:
+                    self._breaker.pop(b, None)
         return False
 
     def _record_admin_failure(self, brokers) -> None:
         now = self._clock_ms()
-        for b in brokers:
-            st = self._breaker.setdefault(b, [0, 0])
-            st[0] += 1
-            if st[0] >= self._breaker_threshold and st[1] <= now:
-                st[1] = now + self._breaker_cooldown_ms
-                self._sensor_breaker_opens.inc()
+        with self._lock:
+            for b in brokers:
+                st = self._breaker.setdefault(b, [0, 0])
+                st[0] += 1
+                if st[0] >= self._breaker_threshold and st[1] <= now:
+                    st[1] = now + self._breaker_cooldown_ms
+                    self._sensor_breaker_opens.inc()
 
     def _record_admin_success(self, brokers) -> None:
-        for b in brokers:
-            self._breaker.pop(b, None)
+        with self._lock:
+            for b in brokers:
+                self._breaker.pop(b, None)
 
     def _call_admin(self, fn: Callable[[], None], brokers) -> bool:
         """Retry/timeout envelope around one ClusterAdmin call: transient

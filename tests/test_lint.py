@@ -1,0 +1,341 @@
+"""cruise-lint: per-rule fixtures, suppression baseline, and the tier-1
+zero-findings gate.
+
+Each rule gets one positive fixture (a deliberately broken snippet that
+must produce exactly that rule id) and one negative (the idiomatic repo
+pattern, which must stay clean).  The fixtures are written to a tmp tree
+and linted through the same ``run_ast_pass`` entry point the CLI uses, so
+the tests cover the engine plumbing (walking, qualnames, call graph,
+suppressions) too — not just the rule bodies.
+
+The slow jaxpr-audit acceptance check (CRUISE_REPAIR_ORACLE=1 fails
+``step-body-cond-free``) is marked ``slow``; tier-1 covers the AST layer
+plus the contract-table wiring.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from tools.lint import engine  # noqa: E402
+from tools.lint import contracts  # noqa: E402
+
+REPO = str(Path(__file__).resolve().parent.parent)
+
+
+def _lint_snippet(tmp_path, source, relpath="cruise_control_tpu/snippet.py"):
+    full = tmp_path / relpath
+    full.parent.mkdir(parents=True, exist_ok=True)
+    full.write_text(source)
+    findings, _ = engine.run_ast_pass(str(tmp_path), [relpath])
+    return findings
+
+
+def _rules(findings, suppressed=False):
+    return sorted({f.rule for f in findings if f.suppressed == suppressed})
+
+
+# ---------------------------------------------------------------------------
+# trace-purity
+# ---------------------------------------------------------------------------
+
+def test_trace_purity_flags_hash_in_traced_fn(tmp_path):
+    findings = _lint_snippet(tmp_path, """\
+import jax
+
+def seed_mix(name):
+    return hash(name) % 7
+
+def program(x):
+    return x + seed_mix("t")
+
+fn = jax.jit(program)
+""")
+    assert _rules(findings) == ["trace-purity"]
+    (f,) = [x for x in findings if not x.suppressed]
+    assert "hash()" in f.message and "seed_mix" in f.message
+
+
+def test_trace_purity_flags_clock_and_env_via_lax(tmp_path):
+    findings = _lint_snippet(tmp_path, """\
+import os
+import time
+import jax
+
+def body(c):
+    _ = time.time()
+    _ = os.environ.get("CRUISE_X")
+    return c
+
+def run(c):
+    return jax.lax.while_loop(lambda c: c < 3, body, c)
+""")
+    assert _rules(findings) == ["trace-purity"]
+    msgs = [f.message for f in findings if not f.suppressed]
+    assert any("time.time" in m for m in msgs)
+    assert any("environment read" in m for m in msgs)
+
+
+def test_trace_purity_ignores_host_side_and_jax_random(tmp_path):
+    findings = _lint_snippet(tmp_path, """\
+import time
+import jax
+import jax.numpy as jnp
+
+def program(x, key):
+    return x + jax.random.uniform(key)
+
+fn = jax.jit(program)
+
+def host_driver():
+    t0 = time.time()
+    return time.time() - t0
+""")
+    assert _rules(findings) == []
+
+
+# ---------------------------------------------------------------------------
+# cache-key
+# ---------------------------------------------------------------------------
+
+def test_cache_key_flags_unkeyed_env_flag(tmp_path):
+    findings = _lint_snippet(tmp_path, """\
+import os
+from functools import partial
+import jax
+
+_cache = {}
+
+def _body(m, flip=False):
+    return -m if flip else m
+
+def get_fn(spec):
+    flip = os.environ.get("CRUISE_FLIP") == "1"
+    key = (spec,)
+    fn = _cache.get(key)
+    if fn is None:
+        fn = jax.jit(partial(_body, flip=flip))
+        _cache[key] = fn
+    return fn
+""")
+    assert "cache-key" in _rules(findings)
+    (f,) = [x for x in findings if x.rule == "cache-key"]
+    assert "CRUISE_FLIP" in f.message
+
+
+def test_cache_key_accepts_repo_idiom_and_reader_helpers(tmp_path):
+    findings = _lint_snippet(tmp_path, """\
+import os
+from functools import partial
+import jax
+
+_cache = {}
+
+def _oracle():
+    return os.environ.get("CRUISE_ORACLE") == "1"
+
+def _body(m, oracle=False):
+    return -m if oracle else m
+
+def get_fn(spec):
+    oracle = _oracle()
+    key = (spec, oracle)
+    fn = _cache.get(key)
+    if fn is None:
+        fn = jax.jit(partial(_body, oracle=oracle))
+        _cache[key] = fn
+    return fn
+""")
+    assert "cache-key" not in _rules(findings)
+
+
+# ---------------------------------------------------------------------------
+# implicit-sync
+# ---------------------------------------------------------------------------
+
+def test_implicit_sync_flags_fetch_outside_whitelist(tmp_path):
+    findings = _lint_snippet(tmp_path, """\
+import jax
+
+def poll(x):
+    return float(jax.device_get(x))
+
+def peek(x):
+    return x.item()
+""")
+    assert _rules(findings) == ["implicit-sync"]
+    assert len([f for f in findings if not f.suppressed]) == 2
+
+
+def test_implicit_sync_respects_whitelisted_site(tmp_path):
+    # contracts.FETCH_SITES whitelists this exact (path, qualname).
+    findings = _lint_snippet(tmp_path, """\
+import jax
+
+class DeviceScorer:
+    def scores(self, x):
+        return jax.device_get(x)
+""", relpath="cruise_control_tpu/detector/device.py")
+    assert _rules(findings) == []
+
+
+# ---------------------------------------------------------------------------
+# donation-safety
+# ---------------------------------------------------------------------------
+
+def test_donation_safety_flags_use_after_donating_call(tmp_path):
+    findings = _lint_snippet(tmp_path, """\
+import jax
+
+def drive(model, opts):
+    fix = jax.jit(step, donate_argnums=(0,))
+    out = fix(model, opts)
+    return model.num_brokers, out
+""")
+    assert _rules(findings) == ["donation-safety"]
+    (f,) = [x for x in findings if not x.suppressed]
+    assert "'model'" in f.message
+
+
+def test_donation_safety_accepts_rebind_and_copy(tmp_path):
+    findings = _lint_snippet(tmp_path, """\
+import jax
+
+def drive(model, opts, steps):
+    fix = jax.jit(step, donate_argnums=(0,))
+    work = donation_copy(model)
+    for _ in range(steps):
+        work = fix(work, opts)
+    return work, model.num_brokers
+""")
+    assert _rules(findings) == []
+
+
+# ---------------------------------------------------------------------------
+# guarded-by
+# ---------------------------------------------------------------------------
+
+_GUARDED_SRC = """\
+import threading
+
+class Facade:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cached = None  # guarded-by: _lock
+
+    def refresh(self, value):
+        {mutation}
+
+    def _locked_refresh(self, value):  # holds-lock: _lock
+        self._cached = value
+"""
+
+
+def test_guarded_by_flags_lockfree_mutation(tmp_path):
+    findings = _lint_snippet(
+        tmp_path, _GUARDED_SRC.format(mutation="self._cached = value"))
+    assert _rules(findings) == ["guarded-by"]
+    (f,) = [x for x in findings if not x.suppressed]
+    assert "_cached" in f.message and "refresh" in f.message
+
+
+def test_guarded_by_accepts_with_lock_and_holds_lock(tmp_path):
+    findings = _lint_snippet(
+        tmp_path, _GUARDED_SRC.format(
+            mutation="with self._lock:\n            self._cached = value"))
+    assert _rules(findings) == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions + baseline
+# ---------------------------------------------------------------------------
+
+def test_suppression_requires_reason_and_marks_finding(tmp_path):
+    findings = _lint_snippet(tmp_path, """\
+import jax
+
+def a(x):
+    return x + hash("a")  # cruise-lint: disable=trace-purity (fixture: documented)
+
+def b(x):
+    return x + hash("b")  # cruise-lint: disable=trace-purity
+
+fa = jax.jit(a)
+fb = jax.jit(b)
+""")
+    suppressed = [f for f in findings if f.suppressed]
+    assert [f.rule for f in suppressed] == ["trace-purity"]
+    assert suppressed[0].reason == "fixture: documented"
+    # The bare disable is itself a finding AND its target stays live.
+    live = _rules(findings)
+    assert "suppression-syntax" in live and "trace-purity" in live
+
+
+def test_baseline_pins_suppression_counts():
+    errors, _ = engine.check_baseline({"trace-purity": 1},
+                                      {"trace-purity": 2})
+    assert errors and "exceed" in errors[0]
+    errors, hints = engine.check_baseline({"trace-purity": 1}, {})
+    assert not errors and hints  # fewer than pinned → ratchet hint only
+    errors, _ = engine.check_baseline(None, {"guarded-by": 1})
+    assert errors  # suppressions with no committed baseline fail
+
+
+def test_committed_baseline_matches_repo():
+    findings, _ = engine.run_ast_pass(REPO)
+    counts = engine.baseline_counts(findings)
+    baseline = engine.load_baseline(REPO)
+    assert baseline is not None, f"{contracts.BASELINE_FILE} not committed"
+    errors, hints = engine.check_baseline(baseline, counts)
+    assert not errors, errors
+    assert not hints, f"stale baseline, ratchet down: {hints}"
+
+
+# ---------------------------------------------------------------------------
+# tier-1 gate: the full AST pass over the repo is clean
+# ---------------------------------------------------------------------------
+
+def test_repo_has_zero_unsuppressed_findings():
+    findings, _ = engine.run_ast_pass(REPO)
+    unsuppressed = [str(f) for f in findings if not f.suppressed]
+    assert unsuppressed == [], "\n".join(unsuppressed)
+
+
+def test_contract_table_is_consistent():
+    from tools.lint import graph_audit
+
+    ids = [c.id for c in contracts.CONTRACTS]
+    assert len(ids) == len(set(ids)), "duplicate contract ids"
+    for c in contracts.CONTRACTS:
+        assert c.op in ("<=", "=="), c.id
+        assert c.program in graph_audit.PROGRAMS, (
+            f"contract {c.id} names unknown program {c.program}")
+        assert c.why, c.id
+    # The ceilings the budget test imports are the contract bounds.
+    by_id = {c.id: c for c in contracts.CONTRACTS}
+    assert by_id["step-body-equations"].bound == \
+        contracts.BODY_EQUATION_CEILING
+    assert by_id["flight-body-overhead"].bound == \
+        contracts.FLIGHT_BODY_OVERHEAD_CEILING
+
+
+@pytest.mark.slow
+def test_graph_audit_fails_cond_injected_into_repair():
+    """CRUISE_REPAIR_ORACLE=1 selects the legacy cond-gated repair: the
+    audit must fail step-body-cond-free (the acceptance fixture for a cond
+    injected into the repair subgraph)."""
+    env = dict(os.environ, CRUISE_REPAIR_ORACLE="1", JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--graph-only", "--json"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=480)
+    assert out.returncode == 1, out.stdout + out.stderr
+    payload = json.loads(out.stdout.strip().splitlines()[-1])
+    failed = {r["id"] for r in payload["graph"]["contracts"]
+              if r["status"] == "fail"}
+    assert "step-body-cond-free" in failed

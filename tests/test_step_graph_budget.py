@@ -20,26 +20,13 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from tools.step_graph_report import flight_overhead_report, report  # noqa: E402
 
-# Current body count is 2601 (was 1921 pre-bounded-repair: the fixed-depth
-# bisection + subset-closed safe admit run every step instead of hiding a
-# data-dependent drop loop behind a cond — the equations bought constant
-# per-step cost).  Raising the ceiling needs an explicit decision, not a
-# drive-by regression.
-BODY_EQUATION_CEILING = 2680
-# Hoisting moves work OUTSIDE the loop (paid once per fixpoint dispatch) —
-# currently 350 equations.  A loose lid keeps "hoist everything, twice"
-# from silently bloating the once-per-dispatch prelude either.
-OUTER_EQUATION_CEILING = 700
-# The bounded repair's bisection scans — currently 175 equations of the
-# body; attribution is pinned so repair growth is visible separately.
-REPAIR_EQUATION_CEILING = 260
-# The flight recorder (CRUISE_FLIGHT_RECORDER=1) adds per-step telemetry
-# rows to the budget fixpoint's carry — currently 155 body equations and 1
-# outer equation on top of the recorder-off graph.  Opt-in telemetry gets
-# its own lid so it cannot quietly turn into a second hot path; the
-# recorder-OFF trace is asserted identical-cost to the pre-recorder graph.
-FLIGHT_BODY_OVERHEAD_CEILING = 200
-FLIGHT_OUTER_OVERHEAD_CEILING = 10
+# The ceilings live in the cruise-lint contract table — raising one is an
+# explicit, reviewed edit to tools/lint/contracts.py, never a drive-by
+# constant bump here (see docs/STATIC_ANALYSIS.md).
+from tools.lint.contracts import (  # noqa: E402
+    BODY_EQUATION_CEILING, FLIGHT_BODY_OVERHEAD_CEILING,
+    FLIGHT_OUTER_OVERHEAD_CEILING, OUTER_EQUATION_CEILING,
+    REPAIR_EQUATION_CEILING)
 
 
 def test_step_graph_body_within_budget():

@@ -1,0 +1,13 @@
+"""cruise-lint: repo-custom static analysis for the hot-path contracts.
+
+Two layers (see docs/STATIC_ANALYSIS.md):
+
+- an AST pass (``engine`` + ``ast_rules``) enforcing trace-purity,
+  cache-key completeness, implicit-sync whitelisting, donation-safety and
+  guarded-by lock discipline over ``cruise_control_tpu/`` + ``tools/``;
+- a jaxpr auditor (``graph_audit``) tracing the real hot-path programs
+  and checking the declarative contract table (``contracts``).
+
+Run ``python -m tools.lint`` (add ``--json`` for machine output,
+``--ast-only`` to skip the traced-program audit).
+"""

@@ -1,0 +1,120 @@
+"""cruise-lint CLI.
+
+Usage (from the repo root)::
+
+    python -m tools.lint                 # full run: AST pass + jaxpr audit
+    python -m tools.lint --ast-only      # fast: no jax import, no tracing
+    python -m tools.lint --graph-only    # only the traced-program audit
+    python -m tools.lint --json          # one JSON object on stdout
+    python -m tools.lint --write-baseline  # regenerate LINT_BASELINE.json
+
+Exit status 0 iff there are zero unsuppressed findings, the suppression
+counts match the committed baseline, and (unless ``--ast-only``) every
+hot-path contract holds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python -m tools.lint",
+                                description=__doc__.splitlines()[0])
+    p.add_argument("--json", action="store_true",
+                   help="emit one JSON object instead of human output")
+    p.add_argument("--ast-only", action="store_true",
+                   help="skip the jaxpr audit (no jax import; fast)")
+    p.add_argument("--graph-only", action="store_true",
+                   help="skip the AST pass, run only the jaxpr audit")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="regenerate LINT_BASELINE.json from the current "
+                        "suppression counts (review the diff!)")
+    p.add_argument("--root", default=_repo_root(),
+                   help="repo root to lint (default: this checkout)")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="also list suppressed findings with their reasons")
+    args = p.parse_args(argv)
+    if args.ast_only and args.graph_only:
+        p.error("--ast-only and --graph-only are mutually exclusive")
+
+    sys.path.insert(0, args.root)
+    from tools.lint import engine
+
+    out: dict = {"root": args.root}
+    failures: list = []
+
+    if not args.graph_only:
+        findings, _index = engine.run_ast_pass(args.root)
+        unsuppressed = [f for f in findings if not f.suppressed]
+        counts = engine.baseline_counts(findings)
+        if args.write_baseline:
+            path = engine.write_baseline(args.root, counts)
+            if not args.json:
+                print(f"wrote {path}: {counts or '{}'}")
+            base_errors, base_hints = [], []
+        else:
+            base_errors, base_hints = engine.check_baseline(
+                engine.load_baseline(args.root), counts)
+        out["findings"] = [f.to_dict() for f in findings
+                           if not f.suppressed or args.show_suppressed]
+        out["unsuppressed"] = len(unsuppressed)
+        out["suppressed_counts"] = counts
+        out["baseline_errors"] = base_errors
+        out["baseline_hints"] = base_hints
+        failures.extend(str(f) for f in unsuppressed)
+        failures.extend(base_errors)
+        if not args.json:
+            for f in findings:
+                if not f.suppressed:
+                    print(f)
+                elif args.show_suppressed:
+                    print(f"{f}  — {f.reason}")
+            for e in base_errors:
+                print(f"baseline: {e}")
+            for h in base_hints:
+                print(f"baseline hint: {h}")
+
+    if not args.ast_only:
+        from tools.lint import graph_audit
+        audit = graph_audit.run_graph_audit()
+        out["graph"] = audit
+        for r in audit["contracts"]:
+            if r["status"] == "fail":
+                failures.append(
+                    f"contract {r['id']}: {r['metric']}={r['value']} "
+                    f"violates {r['op']} {r['bound']} — {r['why']}")
+            elif r["status"] == "error":
+                failures.append(f"contract {r['id']}: trace failed: "
+                                f"{r['error']}")
+        if not args.json:
+            for name, rec in sorted(audit["programs"].items()):
+                pretty = " ".join(f"{k}={v}" for k, v in sorted(rec.items()))
+                print(f"program {name}: {pretty}")
+            for r in audit["contracts"]:
+                if r["status"] == "fail":
+                    print(f"FAIL {r['id']}: {r['metric']}={r['value']} "
+                          f"(want {r['op']} {r['bound']}) — {r['why']}")
+                elif r["status"] == "error":
+                    print(f"ERROR {r['id']}: {r['error']}")
+
+    out["ok"] = not failures
+    if args.json:
+        print(json.dumps(out), flush=True)
+    elif not failures:
+        print("cruise-lint: ok")
+    else:
+        print(f"cruise-lint: {len(failures)} failure(s)")
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

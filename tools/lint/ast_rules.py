@@ -1,0 +1,569 @@
+"""The cruise-lint AST rules.
+
+Each rule is ``fn(index: PackageIndex) -> List[Finding]`` and encodes one
+invariant the hot path depends on:
+
+- **trace-purity** — functions reachable from a ``jax.jit`` / ``lax.*``
+  callsite must not read wall clocks, the PYTHONHASHSEED-randomized
+  ``hash()``, ``random`` / ``np.random``, the environment, or host files:
+  any of those bakes a per-process value into a compiled program (the
+  exact bug class PR 10 fixed when ``hash()`` in the synthetic sampler
+  flaked CI) or re-enters the host mid-trace.
+- **cache-key** — a function that builds a jitted program and reads a
+  ``CRUISE_*`` env flag (directly or through a helper like
+  ``_repair_oracle``) must key its python-side program cache on the
+  flag's value, or flipping the flag mid-process serves a stale
+  executable.
+- **implicit-sync** — ``jax.device_get`` / ``.item()`` /
+  ``block_until_ready`` may appear only at the whitelisted boundary-fetch
+  sites (``contracts.FETCH_SITES``): the ≤1-fetch-per-boundary dispatch
+  economy (DISPATCH_AUDIT.json) is only honest if no other code path can
+  sync the device.
+- **donation-safety** — an argument donated to a jitted call
+  (``donate_argnums`` / ``donate_model=True`` / ``donate=True`` builder
+  flag) is dead after the call; referencing it again reads a deleted
+  buffer.
+- **guarded-by** — shared mutable attributes declared with a
+  ``# guarded-by: <lock>`` comment must only be mutated inside a
+  ``with self.<lock>:`` block (methods that run entirely under a
+  caller's lock opt out with ``# holds-lock: <lock>`` on their def line).
+"""
+
+from __future__ import annotations
+
+import ast
+import itertools
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.lint.engine import (Finding, FuncInfo, Module, PackageIndex,
+                               PACKAGE, _GUARDED_BY_RE, _HOLDS_LOCK_RE,
+                               env_flag_read)
+from tools.lint import contracts
+
+
+def _walk_own(node: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function body WITHOUT descending into nested def/class
+    scopes (those are separate FuncInfos and get their own pass)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+# ---------------------------------------------------------------------------
+# trace-purity
+# ---------------------------------------------------------------------------
+
+#: Wall-clock reads: value differs per call, so the traced constant is
+#: whatever the clock said at trace time — silently stale forever after.
+_TIME_CALLS = {"time.time", "time.monotonic", "time.perf_counter",
+               "time.time_ns", "time.monotonic_ns", "time.process_time"}
+
+
+def rule_trace_purity(index: PackageIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, int]] = set()
+    for key in sorted(index.traced):
+        info = index.functions.get(key)
+        if info is None:
+            continue
+        path = info.module.path
+        for node in _walk_own(info.node):
+            msg = _impurity(index, path, node)
+            if msg is not None and (path, node.lineno) not in seen:
+                seen.add((path, node.lineno))
+                findings.append(Finding(
+                    rule="trace-purity", path=path, line=node.lineno,
+                    message=f"{msg} inside '{info.qualname}', which is "
+                            f"reachable from a jax trace — the traced "
+                            f"program would bake in a per-process host "
+                            f"value"))
+    return findings
+
+
+def _impurity(index: PackageIndex, path: str, node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Call):
+        name = PackageIndex._call_name(node)
+        if name in _TIME_CALLS:
+            return f"wall-clock read {name}()"
+        if name == "hash":
+            return "builtin hash() (PYTHONHASHSEED-randomized per process)"
+        if name == "open":
+            return "host file I/O open()"
+        parts = name.split(".")
+        if parts[0] == "random" and _is_stdlib_random(index, path):
+            return f"stdlib random call {name}()"
+        if len(parts) >= 2 and parts[1] == "random" \
+                and parts[0] in ("np", "numpy"):
+            return f"numpy RNG call {name}()"
+    flag_or_env = _any_env_read(node)
+    if flag_or_env:
+        return flag_or_env
+    return None
+
+
+def _any_env_read(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Call):
+        name = PackageIndex._expr_name(node.func)
+        if name in ("os.environ.get", "os.getenv"):
+            return f"environment read {name}(...)"
+    elif isinstance(node, ast.Subscript):
+        if PackageIndex._expr_name(node.value) == "os.environ":
+            return "environment read os.environ[...]"
+    elif isinstance(node, ast.Attribute):
+        if PackageIndex._expr_name(node) == "os.environ":
+            return "environment read os.environ"
+    return None
+
+
+def _is_stdlib_random(index: PackageIndex, path: str) -> bool:
+    """True when ``random`` in this module is the stdlib module (an
+    ``import random``), not a local name."""
+    target = index._imports.get(path, {}).get("random")
+    return target == "random"
+
+
+# ---------------------------------------------------------------------------
+# cache-key
+# ---------------------------------------------------------------------------
+
+def rule_cache_key(index: PackageIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    readers = index.env_readers()
+    for key, info in sorted(index.functions.items()):
+        path = info.module.path
+        if not _builds_jit_program(index, path, info):
+            continue
+        env_reads: List[Tuple[ast.AST, str]] = []  # (node, flag/descr)
+        for node in _walk_own(info.node):
+            flag = env_flag_read(node)
+            if flag is not None:
+                env_reads.append((node, flag))
+                continue
+            if isinstance(node, ast.Call):
+                for tgt in index._resolve_call(path, info, node):
+                    if tgt in readers:
+                        env_reads.append((node, readers[tgt]))
+                        break
+        if not env_reads:
+            continue
+        key_elems = _cache_key_elements(info)
+        for node, flag in env_reads:
+            bound = _binding_name(info, node)
+            if bound is not None and bound in key_elems:
+                continue
+            where = (f"assigned to '{bound}' which is missing from"
+                     if bound is not None else "not bound to a name in")
+            findings.append(Finding(
+                rule="cache-key", path=path, line=node.lineno,
+                message=f"env flag {flag} read inside program builder "
+                        f"'{info.qualname}' is {where} the jit cache key "
+                        f"tuple — flipping the flag mid-process would "
+                        f"serve a stale executable"))
+    return findings
+
+
+def _builds_jit_program(index: PackageIndex, path: str,
+                        info: FuncInfo) -> bool:
+    for node in _walk_own(info.node):
+        if isinstance(node, ast.Call):
+            name = PackageIndex._call_name(node)
+            if name.rsplit(".", 1)[-1] == "jit" \
+                    and index._is_jax_call(path, name):
+                return True
+    return False
+
+
+def _cache_key_elements(info: FuncInfo) -> Set[str]:
+    """Names appearing as elements of the function's cache-key tuple: any
+    tuple assigned to a name that is later passed to a ``.get(...)`` call
+    or used as a subscript index (the ``_get_*_fn`` idiom)."""
+    tuples: Dict[str, Set[str]] = {}
+    used_as_key: Set[str] = set()
+    for node in _walk_own(info.node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Tuple):
+            elems = {e.id for e in node.value.elts
+                     if isinstance(e, ast.Name)}
+            tuples.setdefault(node.targets[0].id, set()).update(elems)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("get", "setdefault", "pop"):
+            for a in node.args:
+                if isinstance(a, ast.Name):
+                    used_as_key.add(a.id)
+        elif isinstance(node, ast.Subscript) \
+                and isinstance(node.slice, ast.Name):
+            used_as_key.add(node.slice.id)
+    out: Set[str] = set()
+    for name, elems in tuples.items():
+        if name in used_as_key or name == "key":
+            out.update(elems)
+    return out
+
+
+def _binding_name(info: FuncInfo, read: ast.AST) -> Optional[str]:
+    """The local name an expression's value is assigned to, if the read
+    sits inside a single-target assignment."""
+    for node in _walk_own(info.node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            for sub in ast.walk(node.value):
+                if sub is read:
+                    return node.targets[0].id
+    return None
+
+
+# ---------------------------------------------------------------------------
+# implicit-sync
+# ---------------------------------------------------------------------------
+
+_SYNC_ATTRS = ("device_get", "block_until_ready")
+
+
+def rule_implicit_sync(index: PackageIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, int]] = set()
+    for key, info in sorted(index.functions.items()):
+        path = info.module.path
+        if not path.startswith(PACKAGE + "/"):
+            continue
+        for node in _walk_own(info.node):
+            desc = _sync_site(node)
+            if desc is None or (path, node.lineno) in seen:
+                continue
+            seen.add((path, node.lineno))
+            if _whitelisted(path, info.qualname):
+                continue
+            findings.append(Finding(
+                rule="implicit-sync", path=path, line=node.lineno,
+                message=f"{desc} in '{info.qualname}' is not a "
+                        f"whitelisted boundary-fetch site "
+                        f"(contracts.FETCH_SITES) — it would sync the "
+                        f"device outside the audited fetch budget"))
+    # Module-level statements.
+    for path, mod in sorted(index.modules.items()):
+        if not path.startswith(PACKAGE + "/"):
+            continue
+        covered = {n for k, fi in index.functions.items() if k[0] == path
+                   for n in ast.walk(fi.node)}
+        for node in ast.walk(mod.tree):
+            if node in covered:
+                continue
+            desc = _sync_site(node)
+            if desc is None or (path, node.lineno) in seen:
+                continue
+            seen.add((path, node.lineno))
+            if _whitelisted(path, ""):
+                continue
+            findings.append(Finding(
+                rule="implicit-sync", path=path, line=node.lineno,
+                message=f"{desc} at module level is not a whitelisted "
+                        f"boundary-fetch site (contracts.FETCH_SITES)"))
+    return findings
+
+
+def _sync_site(node: ast.AST) -> Optional[str]:
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        name = PackageIndex._expr_name(fn)
+        if name in ("jax.device_get", "jax.block_until_ready"):
+            return f"{name}(...)"
+        if fn.attr == "item" and not node.args and not node.keywords:
+            return ".item() device fetch"
+    return None
+
+
+def _whitelisted(path: str, qualname: str) -> bool:
+    for wpath, wprefix in contracts.FETCH_SITES:
+        if path == wpath and (wprefix == "" or qualname == wprefix
+                              or qualname.startswith(wprefix + ".")):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# donation-safety
+# ---------------------------------------------------------------------------
+
+def rule_donation_safety(index: PackageIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for key, info in sorted(index.functions.items()):
+        findings.extend(_check_donations(info))
+    return findings
+
+
+def _check_donations(info: FuncInfo) -> List[Finding]:
+    path = info.module.path
+    # Local names bound to donating callables → donated positions.
+    donating: Dict[str, Tuple[int, ...]] = {}
+    for node in _walk_own(info.node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call):
+            call = node.value
+            name = PackageIndex._call_name(call)
+            for kw in call.keywords:
+                if kw.arg == "donate_argnums" \
+                        and name.rsplit(".", 1)[-1] == "jit":
+                    pos = _literal_positions(kw.value)
+                    if pos:
+                        donating[node.targets[0].id] = pos
+                elif kw.arg == "donate" \
+                        and isinstance(kw.value, ast.Constant) \
+                        and kw.value.value is True:
+                    # builder idiom: fn = _get_*_fn(..., donate=True)
+                    donating[node.targets[0].id] = (0,)
+    out: List[Finding] = []
+    for node in _walk_own(info.node):
+        if not isinstance(node, ast.Call):
+            continue
+        donated_args: List[ast.AST] = []
+        fn_name = PackageIndex._call_name(node)
+        for kw in node.keywords:
+            if kw.arg == "donate_model" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is True and node.args:
+                donated_args.append(node.args[0])
+        if isinstance(node.func, ast.Name) and node.func.id in donating:
+            for pos in donating[node.func.id]:
+                if pos < len(node.args):
+                    donated_args.append(node.args[pos])
+        for arg in donated_args:
+            if not isinstance(arg, ast.Name):
+                continue
+            use = _use_after_donation(info, node, arg.id)
+            if use is not None:
+                out.append(Finding(
+                    rule="donation-safety", path=path, line=use.lineno,
+                    message=f"'{arg.id}' is referenced after being donated "
+                            f"to '{fn_name}' at line {node.lineno} — its "
+                            f"buffers are deleted by donation; copy "
+                            f"(donation_copy) or rebind before reuse"))
+    return out
+
+
+def _literal_positions(expr: ast.AST) -> Tuple[int, ...]:
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+        return (expr.value,)
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out = []
+        for e in expr.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+        return tuple(out)
+    return ()
+
+
+def _use_after_donation(info: FuncInfo, call: ast.Call,
+                        name: str) -> Optional[ast.AST]:
+    """First load of ``name`` after the donating call (same scope), unless
+    the call's own statement rebinds it or an assignment intervenes."""
+    call_line = call.lineno
+    rebind_lines: List[int] = []
+    for node in _walk_own(info.node):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name) and sub.id == name:
+                        rebind_lines.append(node.lineno)
+    if any(ln == call_line for ln in rebind_lines):
+        return None  # `m = donating(m, ...)` — rebound immediately
+    loop_span = _enclosing_loop_span(info.node, call)
+    for node in _walk_own(info.node):
+        if not (isinstance(node, ast.Name) and node.id == name
+                and isinstance(node.ctx, ast.Load)):
+            continue
+        after = node.lineno > call_line
+        in_loop_before = (loop_span is not None
+                          and loop_span[0] <= node.lineno < call_line)
+        if not (after or in_loop_before):
+            continue
+        if node is call.func or _contains(call, node):
+            continue
+        if after and any(call_line < ln <= node.lineno
+                         for ln in rebind_lines):
+            continue
+        return node
+    return None
+
+
+def _contains(parent: ast.AST, target: ast.AST) -> bool:
+    return any(n is target for n in ast.walk(parent))
+
+
+def _enclosing_loop_span(fn_node: ast.AST,
+                         target: ast.AST) -> Optional[Tuple[int, int]]:
+    span: Optional[Tuple[int, int]] = None
+
+    def visit(node: ast.AST, cur: Optional[Tuple[int, int]]) -> bool:
+        nonlocal span
+        if node is target:
+            span = cur
+            return True
+        nxt = cur
+        if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+            nxt = (node.lineno, max(getattr(node, "end_lineno", node.lineno),
+                                    node.lineno))
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)) and child is not target:
+                continue
+            if visit(child, nxt):
+                return True
+        return False
+
+    visit(fn_node, None)
+    return span
+
+
+# ---------------------------------------------------------------------------
+# guarded-by
+# ---------------------------------------------------------------------------
+
+_MUTATORS = {"append", "extend", "insert", "pop", "popitem", "clear",
+             "update", "setdefault", "add", "discard", "remove", "sort",
+             "appendleft", "popleft"}
+
+
+def rule_guarded_by(index: PackageIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for path, mod in sorted(index.modules.items()):
+        if not path.startswith(PACKAGE + "/"):
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(_check_class(mod, node))
+    return findings
+
+
+def _check_class(mod: Module, cls: ast.ClassDef) -> List[Finding]:
+    guarded = _declared_guards(mod, cls)
+    if not guarded:
+        return []
+    out: List[Finding] = []
+    for item in cls.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if item.name == "__init__":
+            continue  # construction precedes sharing
+        held = _held_locks(mod, item)
+        out.extend(_check_method(mod, item, guarded, held))
+    return out
+
+
+def _declared_guards(mod: Module, cls: ast.ClassDef) -> Dict[str, str]:
+    """attr → lock-attr from ``self.X = ...  # guarded-by: <lock>``."""
+    guarded: Dict[str, str] = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        attr = None
+        for t in targets:
+            if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                attr = t.attr
+        if attr is None:
+            continue
+        for line in range(node.lineno,
+                          getattr(node, "end_lineno", node.lineno) + 1):
+            m = _GUARDED_BY_RE.search(mod.line_comment(line))
+            if m:
+                lock = m.group(1).split(".")[-1]
+                guarded[attr] = lock
+                break
+    return guarded
+
+
+def _held_locks(mod: Module, fn: ast.AST) -> Set[str]:
+    """Locks a ``# holds-lock: <lock>`` marker on/above the def line says
+    the caller already holds for the whole method."""
+    held: Set[str] = set()
+    for line in (fn.lineno - 1, fn.lineno):
+        m = _HOLDS_LOCK_RE.search(mod.line_comment(line))
+        if m:
+            held.add(m.group(1).split(".")[-1])
+    return held
+
+
+def _check_method(mod: Module, fn: ast.AST, guarded: Dict[str, str],
+                  held: Set[str]) -> List[Finding]:
+    out: List[Finding] = []
+
+    def visit(node: ast.AST, locks: Set[str]) -> None:
+        cur = set(locks)
+        if isinstance(node, ast.With):
+            for item in node.items:
+                name = PackageIndex._expr_name(item.context_expr)
+                if name:
+                    cur.add(name.split(".")[-1])
+        for attr, descr in _mutations(node):
+            lock = guarded.get(attr)
+            if lock is not None and lock not in cur:
+                out.append(Finding(
+                    rule="guarded-by", path=mod.path, line=node.lineno,
+                    message=f"{descr} of 'self.{attr}' (guarded-by "
+                            f"{lock}) outside a 'with self.{lock}:' "
+                            f"block in '{fn.name}'"))
+        for child in ast.iter_child_nodes(node):
+            visit(child, cur)
+
+    visit(fn, set(held))
+    return out
+
+
+def _mutations(node: ast.AST) -> List[Tuple[str, str]]:
+    """(attr, description) for direct mutations of self.<attr> performed
+    BY this node (not descendants — the visitor recurses)."""
+    out: List[Tuple[str, str]] = []
+
+    def self_attr(expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value,
+                                                          ast.Name) \
+                and expr.value.id == "self":
+            return expr.attr
+        return None
+
+    if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t in targets:
+            attr = self_attr(t)
+            if attr is not None:
+                out.append((attr, "assignment"))
+            elif isinstance(t, ast.Subscript):
+                attr = self_attr(t.value)
+                if attr is not None:
+                    out.append((attr, "item assignment"))
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    attr = self_attr(e)
+                    if attr is not None:
+                        out.append((attr, "assignment"))
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            attr = self_attr(t)
+            if attr is None and isinstance(t, ast.Subscript):
+                attr = self_attr(t.value)
+            if attr is not None:
+                out.append((attr, "deletion"))
+    elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        if node.func.attr in _MUTATORS:
+            attr = self_attr(node.func.value)
+            if attr is not None:
+                out.append((attr, f".{node.func.attr}() mutation"))
+    return out
+
+
+ALL_RULES = (rule_trace_purity, rule_cache_key, rule_implicit_sync,
+             rule_donation_safety, rule_guarded_by)

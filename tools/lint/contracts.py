@@ -1,0 +1,218 @@
+"""The declarative contract table for the analyzer hot path.
+
+Every load-bearing invariant that used to live as an ad-hoc assert in
+``tools/step_graph_report.py``, ``tests/test_step_graph_budget.py`` or a
+dispatch test is declared HERE, once, as data.  Three consumers read it:
+
+- ``tools/lint/graph_audit.py`` traces the real hot-path programs and
+  evaluates every :class:`Contract` against the measured jaxprs;
+- ``tests/test_step_graph_budget.py`` imports the equation ceilings so the
+  budget lives in exactly one place;
+- ``tools/step_graph_report.py`` stays the measurement tool — it reports
+  numbers, this module says what they must be.
+
+Raising a ceiling is an explicit, reviewed edit to this file — never a
+drive-by constant bump next to the code that regressed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+# ---------------------------------------------------------------------------
+# Equation ceilings (the step-graph perf budget)
+# ---------------------------------------------------------------------------
+# Current body count is 2601 (was 1921 pre-bounded-repair: the fixed-depth
+# bisection + subset-closed safe admit run every step instead of hiding a
+# data-dependent drop loop behind a cond — the equations bought constant
+# per-step cost).
+BODY_EQUATION_CEILING = 2680
+# Hoisting moves work OUTSIDE the loop (paid once per fixpoint dispatch) —
+# currently 350 equations.  A loose lid keeps "hoist everything, twice"
+# from silently bloating the once-per-dispatch prelude either.
+OUTER_EQUATION_CEILING = 700
+# The bounded repair's bisection scans — currently 175 equations of the
+# body; attribution is pinned so repair growth is visible separately.
+REPAIR_EQUATION_CEILING = 260
+# The flight recorder (CRUISE_FLIGHT_RECORDER=1) adds per-step telemetry
+# rows to the budget fixpoint's carry — currently 155 body equations and 1
+# outer equation on top of the recorder-off graph.  Opt-in telemetry gets
+# its own lid so it cannot quietly turn into a second hot path.
+FLIGHT_BODY_OVERHEAD_CEILING = 200
+FLIGHT_OUTER_OVERHEAD_CEILING = 10
+
+#: Host-callback primitives that must never appear anywhere in a hot-path
+#: program: each one re-enters Python mid-dispatch, which both serializes
+#: the device and makes the graph unreplayable (the flight recorder's
+#: replay contract assumes pure XLA programs).
+FORBIDDEN_CALLBACK_PRIMITIVES: Tuple[str, ...] = (
+    "pure_callback", "debug_callback", "io_callback", "callback",
+    "outside_call", "host_callback",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Contract:
+    """One statically checkable hot-path invariant.
+
+    ``program`` names a traced program the auditor builds (see
+    ``graph_audit.PROGRAMS``); ``metric`` a key of that program's
+    measurement record; ``op`` one of ``<=``/``==``; ``bound`` the pinned
+    value.  ``why`` is surfaced verbatim in failure messages — it should
+    say what regressed and where the budget discussion lives.
+    """
+
+    id: str
+    program: str
+    metric: str
+    op: str
+    bound: int
+    why: str
+
+    def check(self, value: int) -> bool:
+        if self.op == "<=":
+            return value <= self.bound
+        if self.op == "==":
+            return value == self.bound
+        raise ValueError(f"unknown contract op {self.op!r}")
+
+
+CONTRACTS: Tuple[Contract, ...] = (
+    Contract(
+        id="step-body-equations",
+        program="step_fixpoint", metric="body_equations",
+        op="<=", bound=BODY_EQUATION_CEILING,
+        why="every equation inside the fixpoint while_loop body runs once "
+            "per STEP — hoist step-invariant work into "
+            "compute_step_invariants or precompute host-side constants "
+            "('Hot-path anatomy & perf budget', docs/DESIGN_ANALYZER.md)"),
+    Contract(
+        id="step-outer-equations",
+        program="step_fixpoint", metric="outer_equations",
+        op="<=", bound=OUTER_EQUATION_CEILING,
+        why="the fixpoint prelude is paid once per dispatch; unbounded "
+            "hoisting is still a cost"),
+    Contract(
+        id="repair-subgraph-equations",
+        program="step_fixpoint", metric="repair_scan_equations",
+        op="<=", bound=REPAIR_EQUATION_CEILING,
+        why="the bounded repair's bisection scans are attributed "
+            "separately so repair growth is visible on its own"),
+    Contract(
+        id="step-body-while-free",
+        program="step_fixpoint", metric="body_while_primitives",
+        op="==", bound=0,
+        why="a data-dependent lax.while_loop inside the step body "
+            "destroys the flat-wall repair guarantee (PR 4)"),
+    Contract(
+        id="step-body-cond-free",
+        program="step_fixpoint", metric="body_cond_primitives",
+        op="==", bound=0,
+        why="a branch-divergent lax.cond inside the step body "
+            "destroys the flat-wall repair guarantee (PR 4)"),
+    Contract(
+        id="recorder-off-identity",
+        program="flight_overhead", metric="off_identity_delta",
+        op="==", bound=0,
+        why="the recorder-off budget fixpoint must compile the exact "
+            "pre-recorder graph — flight telemetry is opt-in, its cost "
+            "must be zero when off"),
+    Contract(
+        id="flight-body-overhead",
+        program="flight_overhead", metric="body_overhead",
+        op="<=", bound=FLIGHT_BODY_OVERHEAD_CEILING,
+        why="the recorder budget is one row-build + one buffer scatter "
+            "per step; anything beyond that belongs behind its own flag "
+            "or in the host-side stitcher"),
+    Contract(
+        id="flight-outer-overhead",
+        program="flight_overhead", metric="outer_overhead",
+        op="<=", bound=FLIGHT_OUTER_OVERHEAD_CEILING,
+        why="recorder-on may only add prelude equations for the ring "
+            "buffer init"),
+    Contract(
+        id="step-fixpoint-callback-free",
+        program="step_fixpoint", metric="callback_primitives",
+        op="==", bound=0,
+        why="host callbacks re-enter Python mid-dispatch and make the "
+            "solve unreplayable"),
+    Contract(
+        id="stack-fixpoint-callback-free",
+        program="stack_fixpoint", metric="callback_primitives",
+        op="==", bound=0,
+        why="the fused multi-goal program is the pipelining hot path; a "
+            "callback would serialize every overlapped goal"),
+    Contract(
+        id="sweep-callback-free",
+        program="satisfied_sweep", metric="callback_primitives",
+        op="==", bound=0,
+        why="the fused satisfied sweep answers standing-proposal hits; a "
+            "callback would put Python on the zero-dispatch read path"),
+    Contract(
+        id="sweep-while-free",
+        program="satisfied_sweep", metric="while_primitives",
+        op="==", bound=0,
+        why="the sweep is one fixed-shape pass over the stack — a "
+            "data-dependent loop here means a goal's satisfied check "
+            "stopped being branch-free"),
+    Contract(
+        id="device-scorer-callback-free",
+        program="device_scorer", metric="callback_primitives",
+        op="==", bound=0,
+        why="detector scoring is one batched dispatch per aggregation "
+            "generation; callbacks would scale it with fleet size again"),
+    Contract(
+        id="device-scorer-while-free",
+        program="device_scorer", metric="while_primitives",
+        op="==", bound=0,
+        why="the (broker × resource × window) scorer is branch-free "
+            "masked reductions by construction (PR 10)"),
+)
+
+
+# ---------------------------------------------------------------------------
+# Implicit-sync whitelist: the boundary-fetch sites
+# ---------------------------------------------------------------------------
+#: Every ``jax.device_get`` / ``.item()`` in ``cruise_control_tpu/`` must
+#: sit inside one of these (path, qualname-prefix) sites.  These are the
+#: audited boundary fetches that keep ``FETCH_COUNTERS`` honest — the
+#: chunk driver's ≤1-fetch-per-boundary budget (DISPATCH_AUDIT.json) only
+#: means anything if no other code path quietly syncs the device.  Adding
+#: a site here is a reviewed decision: it must either count itself in
+#: FETCH_COUNTERS / DEVICE_COUNTERS / SWEEP_COUNTERS or run strictly
+#: outside the solve path (post-run host conversion, simulation bridge).
+#: Cross-linked from docs/OBSERVABILITY.md ("Dispatch economy").
+FETCH_SITES: Tuple[Tuple[str, str], ...] = (
+    # The chunk driver's single boundary fetch + the grouped stack driver
+    # and dense fallbacks inside _optimize (counted in FETCH_COUNTERS).
+    ("cruise_control_tpu/analyzer/optimizer.py", "frontier_fixpoint"),
+    ("cruise_control_tpu/analyzer/optimizer.py", "_optimize"),
+    # Ledger checkpoint re-scoring: phase-boundary only, one batched jit.
+    ("cruise_control_tpu/analyzer/optimizer.py", "PlacementScorer.score"),
+    # Standing-proposal confirm sweep (counted in SWEEP_COUNTERS).
+    ("cruise_control_tpu/api/facade.py", "CruiseControl._confirm_standing"),
+    # Detector scoring fetch (counted in DEVICE_COUNTERS) + the detection
+    # goal sweep (counted in SWEEP_COUNTERS).
+    ("cruise_control_tpu/detector/device.py", "DeviceScorer.scores"),
+    ("cruise_control_tpu/detector/device.py",
+     "DeviceGoalViolationDetector"),
+    # Post-run host conversions — never inside a solve.
+    ("cruise_control_tpu/model/stats.py", "ClusterModelStats.to_dict"),
+    ("cruise_control_tpu/analyzer/proposals.py", "diff"),
+    ("cruise_control_tpu/analyzer/provisioning.py", ""),
+    # Simulation / mesh sidecar host bridges.
+    ("cruise_control_tpu/executor/simulate.py", ""),
+    ("cruise_control_tpu/parallel/sidecar.py", ""),
+)
+
+
+# ---------------------------------------------------------------------------
+# AST-pass scope
+# ---------------------------------------------------------------------------
+#: Directories the AST pass walks (repo-relative).
+LINT_ROOTS: Tuple[str, ...] = ("cruise_control_tpu", "tools")
+#: Extra single files included in the walk.
+LINT_EXTRA_FILES: Tuple[str, ...] = ("bench.py",)
+#: The committed suppression baseline.
+BASELINE_FILE = "LINT_BASELINE.json"

@@ -1,0 +1,564 @@
+"""cruise-lint engine: file walking, suppressions, baseline, package index.
+
+The AST layer is a repo-custom rule engine, not a general linter: every
+rule in ``tools/lint/ast_rules.py`` encodes ONE invariant this codebase
+actually depends on, and the engine's job is the shared plumbing —
+
+- walk ``cruise_control_tpu/`` + ``tools/`` (+ ``bench.py``), parse once,
+  hand every rule a :class:`PackageIndex` with qualnames, a conservative
+  intra-package call graph, and the set of trace roots (functions that
+  end up inside ``jax.jit`` / ``lax.*`` programs);
+- apply ``# cruise-lint: disable=RULE (reason)`` suppressions — the
+  reason is MANDATORY; a bare disable is itself a finding;
+- compare suppression counts against the committed ``LINT_BASELINE.json``
+  so new suppressions fail loudly while removing one just asks for a
+  baseline ratchet.
+
+Suppression syntax (same line as the finding, or a comment-only line
+directly above it)::
+
+    x = hash(name)  # cruise-lint: disable=trace-purity (host-side id only)
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.lint import contracts
+
+PACKAGE = "cruise_control_tpu"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*cruise-lint:\s*disable=([A-Za-z0-9_,-]+)\s*(\(([^)]*)\))?")
+_GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_.]*)")
+_HOLDS_LOCK_RE = re.compile(r"#\s*holds-lock:\s*([A-Za-z_][A-Za-z0-9_.]*)")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str          # repo-relative
+    line: int
+    message: str
+    suppressed: bool = False
+    reason: str = ""
+
+    def to_dict(self) -> Dict[str, object]:
+        out = {"rule": self.rule, "path": self.path, "line": self.line,
+               "message": self.message}
+        if self.suppressed:
+            out["suppressed"] = True
+            out["reason"] = self.reason
+        return out
+
+    def __str__(self) -> str:
+        tag = " [suppressed]" if self.suppressed else ""
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}{tag}"
+
+
+@dataclasses.dataclass
+class Module:
+    """One parsed source file."""
+
+    path: str                       # repo-relative, posix separators
+    modname: Optional[str]          # dotted module name if importable
+    source: str
+    tree: ast.Module
+    lines: List[str]
+    # line → {rule, ...} or {"*"}; reasons kept for reporting.
+    suppressions: Dict[int, Dict[str, str]]
+    bad_suppressions: List[int]     # disables with no (reason)
+
+    @classmethod
+    def parse(cls, root: str, relpath: str) -> Optional["Module"]:
+        full = os.path.join(root, relpath)
+        try:
+            with open(full, "r", encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=relpath)
+        except (OSError, SyntaxError):
+            return None
+        lines = source.splitlines()
+        sup: Dict[int, Dict[str, str]] = {}
+        bad: List[int] = []
+        for i, ln in enumerate(lines, start=1):
+            m = _SUPPRESS_RE.search(ln)
+            if not m:
+                continue
+            rules = [r.strip() for r in m.group(1).split(",") if r.strip()]
+            reason = (m.group(3) or "").strip()
+            if not reason:
+                bad.append(i)
+                continue
+            targets = [i]
+            # A comment-only suppression line covers the next line.
+            if ln.split("#", 1)[0].strip() == "":
+                targets.append(i + 1)
+            for t in targets:
+                d = sup.setdefault(t, {})
+                for r in rules:
+                    d[r] = reason
+        modname = None
+        norm = relpath.replace(os.sep, "/")
+        if norm.endswith(".py"):
+            parts = norm[:-3].split("/")
+            if parts[-1] == "__init__":
+                parts = parts[:-1]
+            modname = ".".join(parts) if parts else None
+        return cls(path=norm, modname=modname, source=source, tree=tree,
+                   lines=lines, suppressions=sup, bad_suppressions=bad)
+
+    def suppression_for(self, rule: str, line: int) -> Optional[str]:
+        d = self.suppressions.get(line)
+        if d is None:
+            return None
+        if rule in d:
+            return d[rule]
+        return d.get("*")
+
+    def line_comment(self, line: int) -> str:
+        """The comment text of a 1-based source line ('' when none)."""
+        if 1 <= line <= len(self.lines):
+            ln = self.lines[line - 1]
+            if "#" in ln:
+                return ln[ln.index("#"):]
+        return ""
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One function/method definition with resolution context."""
+
+    qualname: str                   # e.g. CruiseControl._confirm_standing
+    module: Module
+    node: ast.AST                   # FunctionDef / AsyncFunctionDef
+    cls: Optional[str]              # enclosing class name, if a method
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.module.path, self.qualname)
+
+
+class PackageIndex:
+    """Parsed modules + function table + call graph + trace roots.
+
+    The call graph is deliberately conservative and *name-based*: a call
+    ``f(...)`` resolves to any same-module function named ``f`` plus any
+    in-walk function imported under that name; ``mod.f(...)`` resolves
+    through import aliases; ``self.f(...)`` resolves within the enclosing
+    class.  Over-approximation is fine — reachability is used to SCOPE
+    purity checks, and a too-big reachable set errs toward strictness.
+    """
+
+    def __init__(self, root: str, relpaths: Sequence[str]):
+        self.root = root
+        self.modules: Dict[str, Module] = {}
+        for rel in relpaths:
+            mod = Module.parse(root, rel)
+            if mod is not None:
+                self.modules[mod.path] = mod
+        # (path, qualname) → FuncInfo, and name-based lookup tables.
+        self.functions: Dict[Tuple[str, str], FuncInfo] = {}
+        # module path → {bare name → [qualname, ...]}
+        self._by_name: Dict[str, Dict[str, List[str]]] = {}
+        # module path → {class → {method → qualname}}
+        self._methods: Dict[str, Dict[str, Dict[str, str]]] = {}
+        # module path → {alias → dotted module or (module, attr)}
+        self._imports: Dict[str, Dict[str, object]] = {}
+        self._modname_to_path = {m.modname: p
+                                 for p, m in self.modules.items() if m.modname}
+        for path, mod in self.modules.items():
+            self._index_module(path, mod)
+        self.call_graph = self._build_call_graph()
+        self.trace_roots = self._find_trace_roots()
+        self.traced = self._reachable(self.trace_roots)
+
+    # -- indexing ----------------------------------------------------------
+    def _index_module(self, path: str, mod: Module) -> None:
+        by_name: Dict[str, List[str]] = {}
+        methods: Dict[str, Dict[str, str]] = {}
+        imports: Dict[str, object] = {}
+
+        def visit(node: ast.AST, prefix: str, cls: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{prefix}{child.name}"
+                    info = FuncInfo(qualname=qual, module=mod, node=child,
+                                    cls=cls)
+                    self.functions[(path, qual)] = info
+                    by_name.setdefault(child.name, []).append(qual)
+                    if cls is not None:
+                        methods.setdefault(cls, {})[child.name] = qual
+                    visit(child, f"{qual}.", cls)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, f"{prefix}{child.name}.", child.name)
+                elif isinstance(child, ast.Import):
+                    for a in child.names:
+                        imports[a.asname or a.name.split(".")[0]] = a.name
+                elif isinstance(child, ast.ImportFrom):
+                    base = self._resolve_from(mod, child)
+                    if base is None:
+                        continue
+                    for a in child.names:
+                        imports[a.asname or a.name] = (base, a.name)
+                else:
+                    visit(child, prefix, cls)
+
+        visit(mod.tree, "", None)
+        self._by_name[path] = by_name
+        self._methods[path] = methods
+        self._imports[path] = imports
+
+    @staticmethod
+    def _resolve_from(mod: Module, node: ast.ImportFrom) -> Optional[str]:
+        """Dotted module a ``from X import y`` refers to (relative imports
+        resolved against the module's own dotted name)."""
+        if node.level == 0:
+            return node.module
+        if mod.modname is None:
+            return None
+        parts = mod.modname.split(".")
+        if mod.path.endswith("__init__.py"):
+            base = parts[: len(parts) - node.level + 1]
+        else:
+            base = parts[: len(parts) - node.level]
+        if node.module:
+            base = base + node.module.split(".")
+        return ".".join(base) if base else None
+
+    # -- call resolution ---------------------------------------------------
+    def _resolve_call(self, path: str, caller: FuncInfo,
+                      call: ast.Call) -> List[Tuple[str, str]]:
+        fn = call.func
+        out: List[Tuple[str, str]] = []
+        if isinstance(fn, ast.Name):
+            out.extend(self.resolve_name(path, caller, fn.id))
+        elif isinstance(fn, ast.Attribute):
+            out.extend(self._resolve_attribute(path, caller, fn))
+        return out
+
+    def resolve_name(self, path: str, caller: Optional[FuncInfo],
+                     name: str) -> List[Tuple[str, str]]:
+        """Targets a bare ``name(...)`` call may reach (conservative)."""
+        out: List[Tuple[str, str]] = []
+        # Nested function in the same enclosing scope chain first.
+        if caller is not None:
+            prefix = caller.qualname + "."
+            if (path, prefix + name) in self.functions:
+                out.append((path, prefix + name))
+        for qual in self._by_name.get(path, {}).get(name, []):
+            out.append((path, qual))
+        target = self._imports.get(path, {}).get(name)
+        if isinstance(target, tuple):
+            base, attr = target
+            tpath = self._module_path(base)
+            if tpath is not None:
+                for qual in self._by_name.get(tpath, {}).get(attr, []):
+                    out.append((tpath, qual))
+        return out
+
+    def _resolve_attribute(self, path: str, caller: FuncInfo,
+                           fn: ast.Attribute) -> List[Tuple[str, str]]:
+        out: List[Tuple[str, str]] = []
+        base = fn.value
+        if isinstance(base, ast.Name):
+            if base.id == "self" and caller.cls is not None:
+                qual = self._methods.get(path, {}).get(caller.cls, {}) \
+                                    .get(fn.attr)
+                if qual is not None:
+                    out.append((path, qual))
+                return out
+            target = self._imports.get(path, {}).get(base.id)
+            modname = None
+            if isinstance(target, str):
+                modname = target
+            elif isinstance(target, tuple):
+                # from pkg import module as alias → alias.attr
+                modname = f"{target[0]}.{target[1]}"
+            if modname is not None:
+                tpath = self._module_path(modname)
+                if tpath is not None:
+                    for qual in self._by_name.get(tpath, {}).get(fn.attr, []):
+                        out.append((tpath, qual))
+        return out
+
+    def _module_path(self, modname: str) -> Optional[str]:
+        p = self._modname_to_path.get(modname)
+        if p is not None:
+            return p
+        # package __init__
+        return self._modname_to_path.get(modname + ".__init__")
+
+    # -- call graph + trace roots -----------------------------------------
+    def _build_call_graph(self) -> Dict[Tuple[str, str], Set[Tuple[str, str]]]:
+        graph: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+        for key, info in self.functions.items():
+            edges: Set[Tuple[str, str]] = set()
+            for node in ast.walk(info.node):
+                if isinstance(node, ast.Call):
+                    for tgt in self._resolve_call(info.module.path, info,
+                                                  node):
+                        if tgt != key:
+                            edges.add(tgt)
+            graph[key] = edges
+        return graph
+
+    #: call names whose callable arguments become traced.
+    _TRACING_CALLS = {
+        "jit", "make_jaxpr", "vmap", "pmap", "grad", "value_and_grad",
+        "while_loop", "cond", "scan", "fori_loop", "map", "switch",
+        "custom_jvp", "custom_vjp", "checkpoint", "remat", "eval_shape",
+        "shard_map",
+    }
+
+    def _find_trace_roots(self) -> Set[Tuple[str, str]]:
+        roots: Set[Tuple[str, str]] = set()
+
+        def callable_args(call: ast.Call) -> Iterable[ast.AST]:
+            for a in list(call.args) + [kw.value for kw in call.keywords]:
+                yield a
+
+        def harvest(path: str, caller: Optional[FuncInfo],
+                    expr: ast.AST) -> None:
+            """Resolve a callable expression to trace roots."""
+            if isinstance(expr, ast.Name):
+                roots.update(self.resolve_name(path, caller, expr.id))
+            elif isinstance(expr, ast.Attribute):
+                if caller is not None:
+                    roots.update(self._resolve_attribute(path, caller, expr))
+            elif isinstance(expr, ast.Call):
+                # partial(f, ...) / functools.partial(f, ...): f is traced.
+                fname = self._call_name(expr)
+                if fname in ("partial", "functools.partial") and expr.args:
+                    harvest(path, caller, expr.args[0])
+                elif isinstance(expr.func, ast.Lambda):
+                    pass
+
+        for key, info in self.functions.items():
+            path = info.module.path
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = self._call_name(node)
+                short = name.rsplit(".", 1)[-1]
+                if short not in self._TRACING_CALLS:
+                    continue
+                if not self._is_jax_call(path, name):
+                    continue
+                for a in callable_args(node):
+                    harvest(path, info, a)
+        # Module-level tracing calls (e.g. compute_stats_jit =
+        # jax.jit(compute_stats)) and decorators.
+        for path, mod in self.modules.items():
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call):
+                    name = self._call_name(node)
+                    if (name.rsplit(".", 1)[-1] in self._TRACING_CALLS
+                            and self._is_jax_call(path, name)):
+                        for a in list(node.args) + [kw.value
+                                                    for kw in node.keywords]:
+                            if isinstance(a, ast.Name):
+                                roots.update(self.resolve_name(path, None,
+                                                               a.id))
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for dec in node.decorator_list:
+                        dn = self._call_name(dec) if isinstance(dec, ast.Call) \
+                            else self._expr_name(dec)
+                        if dn and dn.rsplit(".", 1)[-1] in ("jit",) \
+                                and self._is_jax_call(path, dn):
+                            for k, fi in self.functions.items():
+                                if k[0] == path and fi.node is node:
+                                    roots.add(k)
+        return roots
+
+    @staticmethod
+    def _expr_name(expr: ast.AST) -> str:
+        if isinstance(expr, ast.Name):
+            return expr.id
+        if isinstance(expr, ast.Attribute):
+            inner = PackageIndex._expr_name(expr.value)
+            return f"{inner}.{expr.attr}" if inner else expr.attr
+        return ""
+
+    @classmethod
+    def _call_name(cls, call: ast.AST) -> str:
+        if isinstance(call, ast.Call):
+            return cls._expr_name(call.func)
+        return cls._expr_name(call)
+
+    def _is_jax_call(self, path: str, dotted: str) -> bool:
+        """Heuristic: the dotted callee belongs to jax (jax.jit, lax.scan,
+        jax.lax.while_loop, bare jit/while_loop imported from jax)."""
+        parts = dotted.split(".")
+        if parts[0] in ("jax", "lax"):
+            return True
+        target = self._imports.get(path, {}).get(parts[0])
+        if isinstance(target, str):
+            return target.split(".")[0] == "jax"
+        if isinstance(target, tuple):
+            return str(target[0]).split(".")[0] == "jax"
+        # bare name: trust only the canonical jax entry points
+        return len(parts) == 1 and parts[0] in ("jit", "make_jaxpr")
+
+    def _reachable(self, roots: Set[Tuple[str, str]]
+                   ) -> Set[Tuple[str, str]]:
+        seen: Set[Tuple[str, str]] = set()
+        stack = list(roots)
+        while stack:
+            key = stack.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            stack.extend(self.call_graph.get(key, ()))
+        return seen
+
+    # -- env-reader discovery (shared by cache-key rule) -------------------
+    def env_readers(self) -> Dict[Tuple[str, str], str]:
+        """Functions that read a ``CRUISE_*`` env flag, mapped to the flag
+        name.  Used by the cache-key rule: calling one of these inside a
+        program builder is an env read like any other."""
+        out: Dict[Tuple[str, str], str] = {}
+        for key, info in self.functions.items():
+            for node in ast.walk(info.node):
+                flag = env_flag_read(node)
+                if flag is not None:
+                    out[key] = flag
+                    break
+        return out
+
+
+def env_flag_read(node: ast.AST) -> Optional[str]:
+    """``CRUISE_*`` flag name when ``node`` reads it from the environment
+    (``os.environ.get("CRUISE_X")`` / ``os.environ["CRUISE_X"]`` /
+    ``os.getenv("CRUISE_X")``), else None."""
+    target: Optional[ast.AST] = None
+    if isinstance(node, ast.Call):
+        name = PackageIndex._expr_name(node.func)
+        if name in ("os.environ.get", "environ.get", "os.getenv", "getenv"):
+            target = node.args[0] if node.args else None
+    elif isinstance(node, ast.Subscript):
+        if PackageIndex._expr_name(node.value) in ("os.environ", "environ"):
+            target = node.slice
+    if target is None:
+        return None
+    if isinstance(target, ast.Constant) and isinstance(target.value, str) \
+            and target.value.startswith("CRUISE_"):
+        return target.value
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Walking + running
+# ---------------------------------------------------------------------------
+
+def default_paths(root: str) -> List[str]:
+    rels: List[str] = []
+    for top in contracts.LINT_ROOTS:
+        base = os.path.join(root, top)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    rels.append(os.path.relpath(os.path.join(dirpath, fn),
+                                                root))
+    for extra in contracts.LINT_EXTRA_FILES:
+        if os.path.exists(os.path.join(root, extra)):
+            rels.append(extra)
+    return sorted(set(rels))
+
+
+def run_ast_pass(root: str, relpaths: Optional[Sequence[str]] = None
+                 ) -> Tuple[List[Finding], PackageIndex]:
+    """Parse + index + run every AST rule; returns findings with
+    suppressions applied (suppressed findings stay in the list, marked)."""
+    from tools.lint import ast_rules
+
+    if relpaths is None:
+        relpaths = default_paths(root)
+    index = PackageIndex(root, relpaths)
+    findings: List[Finding] = []
+    for mod in index.modules.values():
+        for line in mod.bad_suppressions:
+            findings.append(Finding(
+                rule="suppression-syntax", path=mod.path, line=line,
+                message="cruise-lint disable without a (reason) — the "
+                        "justification is mandatory"))
+    for rule_fn in ast_rules.ALL_RULES:
+        findings.extend(rule_fn(index))
+    for f in findings:
+        mod = index.modules.get(f.path)
+        if mod is None or f.rule == "suppression-syntax":
+            continue
+        reason = mod.suppression_for(f.rule, f.line)
+        if reason is not None:
+            f.suppressed = True
+            f.reason = reason
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, index
+
+
+def baseline_counts(findings: Sequence[Finding]) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        if f.suppressed:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+    return counts
+
+
+def load_baseline(root: str) -> Optional[Dict[str, int]]:
+    path = os.path.join(root, contracts.BASELINE_FILE)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return {str(k): int(v) for k, v in data.get("suppressions", {}).items()}
+
+
+def write_baseline(root: str, counts: Dict[str, int]) -> str:
+    path = os.path.join(root, contracts.BASELINE_FILE)
+    payload = {
+        "comment": "Pinned cruise-lint suppression counts per rule. A new "
+                   "suppression fails the lint until this file is "
+                   "explicitly regenerated (python -m tools.lint "
+                   "--write-baseline) and reviewed.",
+        "suppressions": dict(sorted(counts.items())),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=False)
+        f.write("\n")
+    return path
+
+
+def check_baseline(baseline: Optional[Dict[str, int]],
+                   counts: Dict[str, int]) -> Tuple[List[str], List[str]]:
+    """(errors, ratchet_hints): errors when suppressions exceed the pinned
+    counts (or no baseline is committed at all), hints when the code has
+    fewer suppressions than pinned (ratchet the baseline down)."""
+    errors: List[str] = []
+    hints: List[str] = []
+    if baseline is None:
+        if counts:
+            errors.append(
+                f"{contracts.BASELINE_FILE} missing but "
+                f"{sum(counts.values())} suppressions exist — commit a "
+                f"reviewed baseline (python -m tools.lint --write-baseline)")
+        return errors, hints
+    for rule in sorted(set(baseline) | set(counts)):
+        have, pinned = counts.get(rule, 0), baseline.get(rule, 0)
+        if have > pinned:
+            errors.append(
+                f"rule {rule}: {have} suppressions exceed the pinned "
+                f"{pinned} — new suppressions need review; if justified, "
+                f"regenerate {contracts.BASELINE_FILE}")
+        elif have < pinned:
+            hints.append(
+                f"rule {rule}: {have} suppressions < pinned {pinned} — "
+                f"ratchet the baseline down")
+    return errors, hints

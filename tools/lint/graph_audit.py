@@ -1,0 +1,266 @@
+"""cruise-lint layer 2: trace the real hot-path programs, audit the jaxprs.
+
+The AST layer reasons about source; this layer reasons about the actual
+compiled artifacts.  It traces every program named by a
+:class:`~tools.lint.contracts.Contract` — the per-goal step fixpoint, the
+flight-recorder budget fixpoint, the fused multi-goal ``_stack_fixpoint``,
+the fused satisfied sweep, and the detector's ``DeviceScorer`` program —
+on the same tiny fixture the tier-1 budget test uses (equation counts are
+shape-independent, see tools/step_graph_report.py), then evaluates the
+declarative contract table against the measured jaxprs.
+
+``repair_oracle`` defaults to the live ``CRUISE_REPAIR_ORACLE`` flag, so
+``CRUISE_REPAIR_ORACLE=1 python -m tools.lint`` audits the graph the
+process would actually compile — the legacy cond-gated repair path fails
+``step-body-cond-free`` by design (that's the acceptance fixture for a
+``cond`` injected into repair).
+
+All jax work is imported lazily: ``--ast-only`` runs never pay for it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from tools.lint import contracts
+
+#: The tier-1 budget fixture (tests/test_step_graph_budget.py): tiny
+#: shapes, identical equation counts to the 50-broker report.
+AUDIT_SHAPE = dict(brokers=8, racks=4, topics=6, mean_ppt=12.0, rf=3)
+AUDIT_GOAL = "ReplicaDistributionGoal"
+FLIGHT_CAPACITY = 16
+STACK_GOALS = ("RackAwareGoal", "ReplicaDistributionGoal")
+
+
+def _count_callbacks(jaxpr) -> int:
+    from tools.step_graph_report import count_primitive
+    return sum(count_primitive(jaxpr, name)
+               for name in contracts.FORBIDDEN_CALLBACK_PRIMITIVES)
+
+
+class _Fixture:
+    """Shared traced-program inputs, built once per audit run."""
+
+    def __init__(self, repair_oracle: Optional[bool]):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")  # never touch the TPU
+
+        from cruise_control_tpu.analyzer import candidates as cgen
+        from cruise_control_tpu.analyzer import optimizer as opt
+        from cruise_control_tpu.analyzer.balancing_constraint import (
+            BalancingConstraint)
+        from cruise_control_tpu.analyzer.goals.specs import goals_by_priority
+        from cruise_control_tpu.analyzer.state import OptimizationOptions
+        from cruise_control_tpu.model.generator import (ClusterSpec,
+                                                        generate_cluster)
+        from tools.step_graph_report import DEFAULT_PREV
+
+        self.opt = opt
+        s = AUDIT_SHAPE
+        spec_m = ClusterSpec(num_brokers=s["brokers"], num_racks=s["racks"],
+                             num_topics=s["topics"],
+                             mean_partitions_per_topic=s["mean_ppt"],
+                             replication_factor=s["rf"],
+                             distribution="exponential", seed=2026)
+        self.model = generate_cluster(spec_m)
+        self.options = OptimizationOptions.none(self.model)
+        self.constraint = BalancingConstraint.default()
+        self.goal = goals_by_priority([AUDIT_GOAL])[0]
+        self.prev_specs = tuple(goals_by_priority(list(DEFAULT_PREV)))
+        self.stack_specs = tuple(goals_by_priority(list(STACK_GOALS)))
+        self.ns = cgen.default_num_sources(self.model)
+        self.nd = cgen.default_num_dests(self.model)
+        # Audit the graph this process would actually compile: the live
+        # CRUISE_REPAIR_ORACLE flag unless the caller pins it.  report()
+        # in tools/step_graph_report.py never threads this, so the oracle
+        # path would otherwise be invisible to the audit.
+        self.repair_oracle = (opt._repair_oracle() if repair_oracle is None
+                              else bool(repair_oracle))
+
+
+def _audit_step_fixpoint(fx: _Fixture) -> Dict[str, int]:
+    import jax
+    from functools import partial
+
+    from tools.step_graph_report import (_find_while_body, count_equations,
+                                         count_primitive, subgraph_equations)
+
+    fix = partial(fx.opt._goal_fixpoint, spec=fx.goal,
+                  prev_specs=fx.prev_specs, constraint=fx.constraint,
+                  num_sources=fx.ns, num_dests=fx.nd, max_steps=256,
+                  repair_oracle=fx.repair_oracle)
+    jaxpr = jax.make_jaxpr(fix)(fx.model, fx.options).jaxpr
+    body = _find_while_body(jaxpr)
+    if body is None:
+        raise RuntimeError("no while_loop found in the fixpoint jaxpr")
+    body_eqns = count_equations(body)
+    return {
+        "repair_oracle": int(fx.repair_oracle),
+        "body_equations": body_eqns,
+        "outer_equations": count_equations(jaxpr) - body_eqns,
+        "repair_scan_equations": subgraph_equations(body, "scan"),
+        "body_while_primitives": count_primitive(body, "while"),
+        "body_cond_primitives": count_primitive(body, "cond"),
+        "callback_primitives": _count_callbacks(jaxpr),
+    }
+
+
+def _audit_flight_overhead(fx: _Fixture) -> Dict[str, int]:
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    from tools.step_graph_report import _find_while_body, count_equations
+
+    def trace(cap: Optional[int]):
+        kwargs = dict(spec=fx.goal, prev_specs=fx.prev_specs,
+                      constraint=fx.constraint, num_sources=fx.ns,
+                      num_dests=fx.nd, repair_oracle=fx.repair_oracle)
+        if cap is not None:
+            kwargs["flight_capacity"] = cap
+        fix = partial(fx.opt._goal_fixpoint_budget, **kwargs)
+        return jax.make_jaxpr(fix)(fx.model, fx.options,
+                                   jnp.int32(FLIGHT_CAPACITY), None)
+
+    closed_off = trace(0)
+    closed_on = trace(FLIGHT_CAPACITY)
+    body_off = _find_while_body(closed_off.jaxpr)
+    body_on = _find_while_body(closed_on.jaxpr)
+    if body_off is None or body_on is None:
+        raise RuntimeError("no while_loop found in the budget jaxpr")
+    b_off, b_on = count_equations(body_off), count_equations(body_on)
+    t_off, t_on = (count_equations(closed_off.jaxpr),
+                   count_equations(closed_on.jaxpr))
+    # Recorder-off identity: capacity 0 must produce EXACTLY the graph the
+    # recorder-absent call produces (no `if capacity is not None` slip),
+    # and retracing must be deterministic (a trace-time impurity — the bug
+    # class the trace-purity rule guards — shows up as jaxpr drift).
+    delta = int(str(closed_off.jaxpr) != str(trace(None).jaxpr))
+    delta += int(str(closed_off.jaxpr) != str(trace(0).jaxpr))
+    return {
+        "flight_capacity": FLIGHT_CAPACITY,
+        "body_equations_off": b_off,
+        "body_equations_on": b_on,
+        "body_overhead": b_on - b_off,
+        "outer_overhead": (t_on - b_on) - (t_off - b_off),
+        "off_identity_delta": delta,
+        "callback_primitives": _count_callbacks(closed_on.jaxpr),
+    }
+
+
+def _audit_stack_fixpoint(fx: _Fixture) -> Dict[str, int]:
+    import jax
+    from functools import partial
+
+    from tools.step_graph_report import count_equations, count_primitive
+
+    stack = partial(fx.opt._stack_fixpoint, specs=fx.stack_specs,
+                    constraint=fx.constraint, num_sources=fx.ns,
+                    num_dests=fx.nd, max_steps=64,
+                    repair_oracle=fx.repair_oracle, flight_capacity=0)
+    jaxpr = jax.make_jaxpr(stack)(fx.model, fx.options).jaxpr
+    return {
+        "goals": len(fx.stack_specs),
+        "equations": count_equations(jaxpr),
+        "while_primitives": count_primitive(jaxpr, "while"),
+        "callback_primitives": _count_callbacks(jaxpr),
+    }
+
+
+def _audit_satisfied_sweep(fx: _Fixture) -> Dict[str, int]:
+    import jax
+    from functools import partial
+
+    from tools.step_graph_report import count_equations, count_primitive
+
+    sweep = partial(fx.opt._stack_satisfied,
+                    specs=fx.prev_specs + (fx.goal,),
+                    constraint=fx.constraint)
+    jaxpr = jax.make_jaxpr(sweep)(fx.model).jaxpr
+    return {
+        "goals": len(fx.prev_specs) + 1,
+        "equations": count_equations(jaxpr),
+        "while_primitives": count_primitive(jaxpr, "while"),
+        "callback_primitives": _count_callbacks(jaxpr),
+    }
+
+
+def _audit_device_scorer(fx: _Fixture) -> Dict[str, int]:
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    from cruise_control_tpu.detector import device as dev
+    from tools.step_graph_report import count_equations, count_primitive
+
+    scorer = dev.DeviceScorer()
+    fn = partial(dev._device_scores,
+                 **dict(zip(dev._PARAM_NAMES, scorer._params())))
+    vals = jnp.zeros((6, 5), jnp.float32)
+    bts = jnp.zeros((6, 5), jnp.float32)
+    wvalid = jnp.zeros((6, 5), jnp.bool_)
+    jaxpr = jax.make_jaxpr(fn)(vals, bts, wvalid).jaxpr
+    return {
+        "equations": count_equations(jaxpr),
+        "while_primitives": count_primitive(jaxpr, "while"),
+        "callback_primitives": _count_callbacks(jaxpr),
+    }
+
+
+PROGRAMS = {
+    "step_fixpoint": _audit_step_fixpoint,
+    "flight_overhead": _audit_flight_overhead,
+    "stack_fixpoint": _audit_stack_fixpoint,
+    "satisfied_sweep": _audit_satisfied_sweep,
+    "device_scorer": _audit_device_scorer,
+}
+
+
+def run_graph_audit(repair_oracle: Optional[bool] = None,
+                    programs: Optional[List[str]] = None) -> Dict[str, object]:
+    """Trace the hot-path programs and evaluate every contract.
+
+    Returns ``{"programs": {name: metrics}, "contracts": [result...],
+    "ok": bool}``; a contract whose program wasn't selected (or whose
+    trace raised) is reported with ``"skipped"``/``"error"`` status rather
+    than silently passing.
+    """
+    fx = _Fixture(repair_oracle)
+    names = list(PROGRAMS) if programs is None else list(programs)
+    measured: Dict[str, Dict[str, int]] = {}
+    errors: Dict[str, str] = {}
+    for name in names:
+        try:
+            measured[name] = PROGRAMS[name](fx)
+        except Exception as exc:  # surface, never silently pass contracts
+            errors[name] = f"{type(exc).__name__}: {exc}"
+    results: List[Dict[str, object]] = []
+    ok = not errors
+    for c in contracts.CONTRACTS:
+        if c.program not in names:
+            results.append({"id": c.id, "status": "skipped",
+                            "program": c.program})
+            continue
+        if c.program in errors:
+            results.append({"id": c.id, "status": "error",
+                            "program": c.program, "error": errors[c.program]})
+            ok = False
+            continue
+        value = measured[c.program].get(c.metric)
+        if value is None:
+            results.append({"id": c.id, "status": "error",
+                            "program": c.program,
+                            "error": f"metric {c.metric!r} not measured"})
+            ok = False
+            continue
+        passed = c.check(int(value))
+        ok = ok and passed
+        results.append({
+            "id": c.id, "status": "pass" if passed else "fail",
+            "program": c.program, "metric": c.metric, "value": int(value),
+            "op": c.op, "bound": c.bound,
+            **({} if passed else {"why": c.why}),
+        })
+    return {"repair_oracle": int(fx.repair_oracle), "programs": measured,
+            "trace_errors": errors, "contracts": results, "ok": ok}
