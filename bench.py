@@ -1497,6 +1497,329 @@ def run_chaos_rung(scale: str, max_candidates, fast: bool) -> dict:
     return rec
 
 
+def run_sla_rung(scale: str, max_candidates, fast: bool) -> dict:
+    """--sla: long-horizon soak rung.  One simulated fleet runs the WHOLE
+    service loop — cruise standing-proposal refreshes, the device detector
+    tick, the facade's live mid-flight replanner and the executor — through
+    ≥ 1 hour of *virtual* continuous churn: sinusoidal traffic drift plus a
+    periodic broker death that self-heals and then recovers (the revived
+    broker rejoins empty).  Every subsystem publishes into the telemetry
+    time-series store on its existing boundaries; the rung's acceptance
+    gates are the SLA rollups read BACK OUT of the store:
+
+      - balancedness floor over the soak window >= the configured
+        threshold (CRUISE_SLA_BALANCEDNESS_FLOOR);
+      - every injected death detected AND healed, zero failed heals, and a
+        final clean detector round;
+      - the store's resident bytes never exceed its byte budget;
+      - /timeseries and /stream answer DURING the soak with the device
+        fetch counters pinned flat across every probe.
+
+    Writes SLA_<rung>.json (tools/sla_report.py renders the ASCII timeline
+    and re-validates the invariants)."""
+    import dataclasses as dc
+    import math
+
+    from cruise_control_tpu.analyzer import optimizer as opt
+    from cruise_control_tpu.api.facade import CruiseControl
+    from cruise_control_tpu.api.server import CruiseControlApi
+    from cruise_control_tpu.common.sensors import SENSORS
+    from cruise_control_tpu.common.timeseries import TELEMETRY
+    from cruise_control_tpu.detector.anomalies import AnomalyType
+    from cruise_control_tpu.detector.detectors import BrokerFailureDetector
+    from cruise_control_tpu.detector.device import DeviceGoalViolationDetector
+    from cruise_control_tpu.detector.manager import AnomalyDetectorManager
+    from cruise_control_tpu.detector.notifier import SelfHealingNotifier
+    from cruise_control_tpu.executor.admin import SimulatedClusterAdmin
+    from cruise_control_tpu.executor.executor import Executor
+    from cruise_control_tpu.monitor.capacity import StaticCapacityResolver
+    from cruise_control_tpu.monitor.load_monitor import LoadMonitor
+    from cruise_control_tpu.monitor.metadata import (BrokerInfo,
+                                                     ClusterMetadata,
+                                                     MetadataClient,
+                                                     PartitionInfo)
+    from cruise_control_tpu.monitor.sampling import SyntheticWorkloadSampler
+
+    # Chaos-rung fleet shape (the soak reuses its CPU-tractable geometry).
+    brokers, racks = max(SCALES[scale][0], 12), max(SCALES[scale][1], 4)
+    topics, parts = (12, 32) if brokers >= 50 else (6, 8)
+    window_ms = 300_000
+    tick_ms = 30_000
+    disk_cap = 20_000.0
+    part_bytes = 100_000_000
+    goals = ["RackAwareGoal", "ReplicaCapacityGoal", "DiskCapacityGoal",
+             "DiskUsageDistributionGoal", "ReplicaDistributionGoal"]
+    hard_goals = goals[:3]
+    # Detection stays on the capacity goals: a death heal relocates
+    # replicas without re-levelling usage, so distribution-goal detection
+    # would keep the queue non-empty forever and mask the question the
+    # soak asks ("does the fleet stay healthy under churn?").
+    det_goals = ["RackAwareGoal", "DiskCapacityGoal"]
+
+    # Soak shape (env-tunable so CI can shrink it; defaults span 3900
+    # virtual seconds = 130 detector ticks, one broker death every 900 s).
+    ticks = int(os.environ.get("CRUISE_SLA_TICKS", "130"))
+    kill_every = int(os.environ.get("CRUISE_SLA_KILL_EVERY", "30"))
+    feed_every = window_ms // tick_ms        # one metric window per 300 s
+    refresh_every = 10                       # cruise loop cadence (300 s)
+    probe_every = 10                         # API probe cadence
+    # Balancedness is the reference's 0–100 score; offline-replica windows
+    # publish nothing (the sentinel is "undefined", not low), so the floor
+    # is over *defined* scores and 80 is a conservative healthy-fleet bar.
+    floor_threshold = float(os.environ.get(
+        "CRUISE_SLA_BALANCEDNESS_FLOOR", "80.0"))
+
+    class _DriftSampler(SyntheticWorkloadSampler):
+        """Sinusoidal fleet-wide traffic drift: window ``w`` samples at
+        1 + 0.35*sin(2*pi*w/12) of nominal — a full swell every hour of
+        virtual time, deterministic per window index."""
+
+        def __init__(self, w):
+            super().__init__()
+            self._f = 1.0 + 0.35 * math.sin(2.0 * math.pi * w / 12.0)
+
+        def get_samples(self, cluster, partitions, start_ms, end_ms,
+                        mode=None):
+            samples = (super().get_samples(cluster, partitions, start_ms,
+                                           end_ms, mode) if mode is not None
+                       else super().get_samples(cluster, partitions,
+                                                start_ms, end_ms))
+            for s in samples.partition_samples:
+                for k in s.metrics:
+                    s.metrics[k] *= self._f
+            return samples
+
+    class _Stack:
+        pass
+
+    def feed(st, sampler=None):
+        t0 = st.window * window_ms
+        st.lm.fetch_once(sampler or st.sampler, t0, t0 + 1)
+        st.window += 1
+
+    def build():
+        st = _Stack()
+        bs = tuple(BrokerInfo(b, rack=f"r{b % racks}", host=f"h{b}")
+                   for b in range(brokers))
+        ps = []
+        for t in range(topics):
+            for p in range(parts):
+                base = (t * 7 + p * 3) % brokers
+                reps = tuple((base + k) % brokers for k in range(3))
+                ps.append(PartitionInfo(f"t{t}", p, leader=reps[0],
+                                        replicas=reps))
+        st.mc = MetadataClient(ClusterMetadata(brokers=bs,
+                                               partitions=tuple(ps)))
+        st.lm = LoadMonitor(st.mc, StaticCapacityResolver(disk=disk_cap),
+                            num_partition_windows=5,
+                            partition_window_ms=window_ms)
+        st.lm.start_up()
+        st.sampler = SyntheticWorkloadSampler()
+        st.window = 0
+        for _ in range(6):
+            feed(st)
+        st.admin = SimulatedClusterAdmin(
+            st.mc, {(f"t{t}", p): part_bytes
+                    for t in range(topics) for p in range(parts)},
+            tick_ms=1000, rate_bytes_per_sec=200_000_000.0)
+        st.ex = Executor(st.admin, st.mc, clock_ms=st.admin.now_ms,
+                         concurrency_adjuster_interval_ms=0)
+        # replan_interval_polls>0 turns on the facade's live mid-flight
+        # replanner for every execution this soak dispatches — heal
+        # executions replan against the drifted loads while in flight,
+        # which is what feeds the executor.replan.* churn series.
+        st.cc = CruiseControl(st.lm, st.ex, st.admin, goals=goals,
+                              hard_goals=hard_goals,
+                              warm_start_enabled=True,
+                              warm_start_delta_threshold=1.0,
+                              max_candidates_per_step=max_candidates,
+                              replan_interval_polls=20)
+        notifier = SelfHealingNotifier(
+            self_healing_enabled=dict.fromkeys(AnomalyType, True),
+            broker_failure_alert_threshold_ms=0,
+            broker_failure_self_healing_threshold_ms=0)
+        st.mgr = AnomalyDetectorManager(
+            notifier, st.cc,
+            executor_busy=lambda: st.ex.has_ongoing_execution)
+        st.bf = BrokerFailureDetector(st.mc)
+        st.mgr.register_detector(
+            DeviceGoalViolationDetector(st.lm, det_goals), tick_ms)
+        st.mgr.register_detector(st.bf, tick_ms)
+        st.baseline_ok = bool(st.cc.rebalance(dryrun=False,
+                                              reason="sla-baseline").ok)
+        st.now = 0
+        return st
+
+    def set_alive(st, broker_id, alive):
+        cluster = st.mc.cluster()
+        st.mc.refresh(dc.replace(cluster, brokers=tuple(
+            dc.replace(b, is_alive=alive) if b.broker_id == broker_id else b
+            for b in cluster.brokers)))
+
+    def heals():
+        return (SENSORS.counter("AnomalyDetector.heals-started").count,
+                SENSORS.counter("AnomalyDetector.heals-failed").count)
+
+    # The store is the rung's measurement instrument: start it empty and
+    # pin its default timestamp source to the soak's virtual clock so every
+    # series reads in fleet time.
+    vclock = [0]
+    TELEMETRY.reset()
+    TELEMETRY.set_clock(lambda: vclock[0])
+    host_t0 = time.monotonic()
+    try:
+        st = build()
+        api = CruiseControlApi(st.cc, detector_manager=st.mgr)
+
+        deaths, pending = [], None
+        probes = {"count": 0, "fetch_flat": True, "stream_events": 0,
+                  "cursor": 0, "max_store_bytes": 0}
+        budget_ok = True
+        for tick in range(1, ticks + 1):
+            st.now += tick_ms
+            vclock[0] = st.now
+            if tick % feed_every == 0:
+                feed(st, _DriftSampler(st.window))
+            if tick % kill_every == 0 and pending is None:
+                victim = (7 + 13 * len(deaths)) % brokers
+                set_alive(st, victim, False)
+                pending = {"victim": victim, "killed_tick": tick,
+                           "killed_t_ms": st.now}
+            found = st.mgr.run_detectors_once(st.now)
+            if pending is not None and found and \
+                    "detected_tick" not in pending:
+                pending["detected_tick"] = tick
+            h0, f0_heal = heals()
+            fleet0 = st.admin.now_ms()
+            st.mgr.handle_anomalies_once(st.now)
+            h1, f1_heal = heals()
+            if pending is not None and h1 > h0:
+                transfer_s = (st.admin.now_ms() - fleet0) / 1000.0
+                pending.update(
+                    healed_tick=tick,
+                    # Detection-to-healed in fleet seconds: whole detector
+                    # ticks elapsed since the kill plus the heal
+                    # execution's own data-plane transfer time.
+                    heal_latency_s=round(
+                        (tick - pending["killed_tick"]) * tick_ms / 1000.0
+                        + transfer_s, 3),
+                    fleet_transfer_s=round(transfer_s, 3))
+                # Recovery: the healed broker rejoins (empty) and the
+                # failure ledger forgets it so it cannot re-alert.
+                set_alive(st, pending["victim"], True)
+                st.bf.forget([pending["victim"]])
+                deaths.append(pending)
+                pending = None
+            if f1_heal > f0_heal:
+                raise SystemExit(
+                    f"sla rung: a heal failed to start at tick {tick} "
+                    f"(virtual t={st.now // 1000}s)")
+            if tick % refresh_every == 0:
+                st.cc.refresh_standing_proposals(warm=True)
+            if tick % probe_every == 0:
+                fc0 = dict(opt.FETCH_COUNTERS)
+                code_l, _, _ = api.handle("GET", "timeseries", {})
+                code_q, _, _ = api.handle(
+                    "GET", "timeseries",
+                    {"series": "detector.balancedness,cruise.standing-hit",
+                     "window": "3600", "step": "60"})
+                code_s, body_s, hdr_s = api.handle(
+                    "GET", "stream", {"since": str(probes["cursor"])})
+                if not (code_l == code_q == code_s == 200):
+                    raise SystemExit(
+                        f"sla rung: API probe failed at tick {tick} "
+                        f"({code_l}/{code_q}/{code_s})")
+                if dict(opt.FETCH_COUNTERS) != fc0:
+                    probes["fetch_flat"] = False
+                probes["count"] += 1
+                probes["stream_events"] += body_s.count("\n")
+                probes["cursor"] = int(hdr_s["X-Stream-Cursor"])
+                sb = TELEMETRY.store_bytes()
+                probes["max_store_bytes"] = max(probes["max_store_bytes"],
+                                                sb)
+                if sb > TELEMETRY.byte_budget():
+                    budget_ok = False
+            if tick % 25 == 0:
+                sys.stderr.write(json.dumps(
+                    {"sla_tick": tick, "virtual_s": st.now // 1000,
+                     "deaths_healed": len(deaths),
+                     "balancedness": st.mgr.balancedness_score()}) + "\n")
+                sys.stderr.flush()
+
+        # Final clean round: after the last heal the detector must come
+        # back empty (all anomalies reached a terminal healed state).
+        st.now += tick_ms
+        vclock[0] = st.now
+        final_found = st.mgr.run_detectors_once(st.now)
+        st.mgr.handle_anomalies_once(st.now)
+
+        now_v = max(st.now, int(st.admin.now_ms()))
+        sla = TELEMETRY.sla(window_ms=now_v + tick_ms, now_ms=now_v)
+        timeline = TELEMETRY.query("detector.balancedness",
+                                   window_ms=st.now + tick_ms,
+                                   step_ms=60_000, now_ms=st.now)
+        host_wall_s = time.monotonic() - host_t0
+    finally:
+        TELEMETRY.set_clock(None)
+
+    bal = sla.get("balancedness") or {}
+    floor = bal.get("floor")
+    gates = {
+        "virtual_span_ge_1h": st.now >= 3_600_000,
+        "balancedness_floor_ok": floor is not None
+        and floor >= floor_threshold,
+        "all_deaths_healed": pending is None and len(deaths) > 0
+        and all("healed_tick" in d for d in deaths),
+        "no_failed_heals": heals()[1] == 0,
+        "final_round_clean": final_found == 0,
+        "byte_budget_ok": budget_ok
+        and TELEMETRY.store_bytes() <= TELEMETRY.byte_budget(),
+        "api_answered_during_soak": probes["count"] > 0,
+        "api_fetches_flat": probes["fetch_flat"],
+    }
+    for name, ok in gates.items():
+        if not ok:
+            raise SystemExit(
+                f"sla rung: gate {name} failed "
+                f"(floor={floor!r} threshold={floor_threshold} "
+                f"deaths={deaths!r} final_found={final_found})")
+
+    rec = {
+        "metric": f"sla_soak_balancedness_floor_{scale}",
+        "value": round(floor, 6),
+        "unit": "score",
+        # First soak artifact IS the yardstick (the chaos-rung convention).
+        "vs_baseline": 1.0,
+        "num_brokers": brokers,
+        "num_replicas": topics * parts * 3,
+        "tick_s": tick_ms / 1000.0,
+        "ticks": ticks,
+        "virtual_span_s": st.now / 1000.0,
+        "fleet_clock_s": round(st.admin.now_ms() / 1000.0, 3),
+        "host_wall_s": round(host_wall_s, 3),
+        "baseline_ok": st.baseline_ok,
+        "floor_threshold": floor_threshold,
+        "deaths": deaths,
+        "sla": sla,
+        "timeline": timeline,
+        "probes": probes,
+        "gates": gates,
+        "store": {"bytes": TELEMETRY.store_bytes(),
+                  "budget": TELEMETRY.byte_budget(),
+                  "points_total": TELEMETRY.points_total,
+                  "points_dropped": TELEMETRY.points_dropped,
+                  "series": len(TELEMETRY.series_names())},
+        **({"fast_mode": True} if fast else {}),
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        f"SLA_{scale}.json")
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1, sort_keys=True)
+        f.write("\n")
+    rec["sla_artifact"] = os.path.basename(path)
+    return rec
+
+
 def main() -> None:
     # Rung selection: --rungs flag > BENCH_SCALE env > default small,mid.
     # The default deliberately stops at mid (~10k replicas): it is the
@@ -1552,6 +1875,14 @@ def main() -> None:
                          "driven through the detect→heal pipeline against "
                          "the simulated fleet, write CHAOS_<rung>.json "
                          "(default rung: mid)")
+    ap.add_argument("--sla", action="store_true",
+                    help="run the long-horizon soak rung(s) instead: drive "
+                         "the full service loop (cruise refresh, detector "
+                         "tick, live replanner, executor) through >=1h of "
+                         "virtual churn with traffic drift and periodic "
+                         "broker death/recovery, gate on the telemetry "
+                         "store's SLA rollups, write SLA_<rung>.json "
+                         "(default rung: mid)")
     args = ap.parse_args()
     if args.flight or args.warm or args.chaos:
         # --warm always records flight telemetry: the WARM artifact's whole
@@ -1559,7 +1890,7 @@ def main() -> None:
         # so every heal solve's convergence rides the detector.heal trace.
         os.environ["CRUISE_FLIGHT_RECORDER"] = "1"
     default_rungs = ("mid" if (args.execute or args.warm or args.pipeline
-                               or args.chaos or args.replan)
+                               or args.chaos or args.replan or args.sla)
                      else "small,mid")
     scale_sel = args.rungs or os.environ.get("BENCH_SCALE") or default_rungs
     scales = (["small", "mid", "large"] if scale_sel == "ladder"
@@ -1621,6 +1952,7 @@ def main() -> None:
                   else "pipeline_stack_speedup_small" if args.pipeline
                   else "chaos_time_to_heal_small" if args.chaos
                   else "replan_time_to_balanced_small" if args.replan
+                  else "sla_soak_balancedness_floor_small" if args.sla
                   else "wall_clock_to_goal_satisfying_proposal_small")
         _record_rung({"metric": metric, "value": 0.0, "unit": "s",
                       "vs_baseline": 0.0, "selftest": True, "lint": lint,
@@ -1628,7 +1960,8 @@ def main() -> None:
                       **({"warm": True} if args.warm else {}),
                       **({"pipeline": True} if args.pipeline else {}),
                       **({"chaos": True} if args.chaos else {}),
-                      **({"replan": True} if args.replan else {})})
+                      **({"replan": True} if args.replan else {}),
+                      **({"sla": True} if args.sla else {})})
         while True:
             signal.pause()
 
@@ -1651,6 +1984,7 @@ def main() -> None:
                if args.pipeline
                else run_chaos_rung(s, max_candidates, fast) if args.chaos
                else run_replan_rung(s, max_candidates, fast) if args.replan
+               else run_sla_rung(s, max_candidates, fast) if args.sla
                else run_rung(s, max_candidates, fast))
         cancel()
         rec["backend"] = platform

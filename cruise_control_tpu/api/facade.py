@@ -30,6 +30,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from cruise_control_tpu.common.sensors import SENSORS
+from cruise_control_tpu.common.timeseries import (FETCHES_SERIES,
+                                                  STANDING_HIT_SERIES,
+                                                  TELEMETRY)
 from cruise_control_tpu.common.tracing import TRACE
 
 from cruise_control_tpu.analyzer import optimizer as opt
@@ -610,8 +613,25 @@ class CruiseControl:
         generation is a pure cache read; an advanced generation runs the
         delta probe → zero-delta confirm / warm solve / cold solve.
         ``force=True`` recomputes even on an unchanged generation
-        (ignore-cache semantics — which also repopulate the cache)."""
-        return self.proposals(ignore_proposal_cache=force, warm=warm)
+        (ignore-cache semantics — which also repopulate the cache).
+
+        This tick is the cruise loop's telemetry publish boundary: the
+        tick wall time, whether the standing proposal answered (hit), and
+        the device-fetch delta across the tick land in :data:`TELEMETRY`
+        as points — host floats already on hand, no extra device work."""
+        hits = SENSORS.counter("CruiseControl.standing-hits",
+                               labels={"op": "proposals"})
+        h0 = hits.count
+        f0 = opt.FETCH_COUNTERS["device_fetches"]
+        t0 = time.monotonic()
+        result = self.proposals(ignore_proposal_cache=force, warm=warm)
+        TELEMETRY.record("cruise.tick-wall-s", time.monotonic() - t0)
+        TELEMETRY.record(STANDING_HIT_SERIES,
+                         1.0 if hits.count > h0 else 0.0)
+        TELEMETRY.record(FETCHES_SERIES,
+                         opt.FETCH_COUNTERS["device_fetches"] - f0)
+        TELEMETRY.record("cruise.proposal-count", len(result.proposals))
+        return result
 
     # ------------------------------------------------------------------
     # Proposals (cached)
@@ -997,6 +1017,10 @@ class CruiseControl:
         }
         if detector_manager is not None:
             out["AnomalyDetectorState"] = detector_manager.state_dict()
+        # Windowed SLA rollups from the telemetry time-series store (1 h
+        # default window) — the long-horizon view next to the point-in-time
+        # substates; see docs/OBSERVABILITY.md "Telemetry time-series & SLA".
+        out["Sla"] = TELEMETRY.sla()
         sensors = SENSORS.snapshot()
         # Per-operation trace rollup (count/totalMs/maxMs by root span name)
         # rides inside the Sensors block so /state answers "where does a

@@ -19,6 +19,17 @@ POST endpoints optionally require 2-step verification via the purgatory
 (``two_step_verification=True``).  Security is a pluggable
 ``SecurityProvider`` (servlet/security/SecurityProvider.java) with
 HTTP-Basic and permissive defaults; roles ADMIN > USER > VIEWER.
+
+Incremental telemetry (``/stream`` cursor semantics — the same
+resume-by-id discipline as the purgatory's ``review_id`` above): every
+point published into the telemetry store carries a contiguous, monotone
+sequence number.  ``GET /stream?since=N`` returns the retained events with
+``seq > N`` as newline-delimited JSON objects (``text/plain`` body, one
+object per line) plus an ``X-Stream-Cursor`` header naming the last seq in
+the batch; a client that reconnects with ``since=<last cursor>`` sees no
+gaps and no duplicates while its cursor is inside the log's retention ring
+(``X-Stream-Truncated: true`` says it fell behind and must re-sync from
+``GET /timeseries``).
 """
 
 from __future__ import annotations
@@ -40,6 +51,7 @@ from cruise_control_tpu.api.facade import CruiseControl
 from cruise_control_tpu.api.purgatory import Purgatory
 from cruise_control_tpu.api.user_tasks import TaskStatus, UserTaskManager
 from cruise_control_tpu.common.sensors import SENSORS
+from cruise_control_tpu.common.timeseries import TELEMETRY
 from cruise_control_tpu.common.tracing import TRACE
 from cruise_control_tpu.detector.anomalies import AnomalyType
 
@@ -47,7 +59,8 @@ PREFIX = "/kafkacruisecontrol"
 
 GET_ENDPOINTS = {"bootstrap", "train", "load", "partition_load", "proposals",
                  "state", "kafka_cluster_state", "user_tasks", "review_board",
-                 "metrics", "trace", "flight", "executor_state"}
+                 "metrics", "trace", "flight", "executor_state",
+                 "timeseries", "stream"}
 POST_ENDPOINTS = {"add_broker", "remove_broker", "fix_offline_replicas",
                   "rebalance", "stop_proposal_execution", "pause_sampling",
                   "resume_sampling", "demote_broker", "admin", "review",
@@ -179,10 +192,15 @@ class CruiseControlApi:
                  two_step_verification: bool = False,
                  security: Optional[SecurityProvider] = None,
                  user_tasks: Optional[UserTaskManager] = None,
-                 purgatory: Optional[Purgatory] = None):
+                 purgatory: Optional[Purgatory] = None,
+                 telemetry=None):
         self.cc = cc
         self.detector_manager = detector_manager
         self.sampler = sampler
+        # The telemetry time-series store /timeseries and /stream read
+        # from; defaults to the process-wide singleton the facade /
+        # detector / ledger publishers write into.
+        self.telemetry = telemetry or TELEMETRY
         self.user_tasks = user_tasks or UserTaskManager()
         self.purgatory = (purgatory or Purgatory()) if two_step_verification \
             else None
@@ -333,6 +351,54 @@ class CruiseControlApi:
         if q.get("format") == "prometheus":
             return 200, PlainText(SENSORS.prometheus_text()), {}
         return 200, SENSORS.snapshot(), {}
+
+    def _ep_timeseries(self, q):
+        """Windowed rollups from the telemetry time-series store
+        (docs/OBSERVABILITY.md "Telemetry time-series & SLA").  Without
+        ``?series=`` lists the known series names and store config; with a
+        comma-separated ``?series=`` returns per-step aggregate buckets
+        (count/sum/min/max/last/mean) over ``?window=`` seconds at
+        ``?step=`` seconds granularity.  Entirely host-side reads — never
+        triggers a device fetch."""
+        names = q.get("series")
+        if not names:
+            return 200, {"series": self.telemetry.series_names(),
+                         "config": self.telemetry.config_dict()}, {}
+        try:
+            window_s = float(q.get("window", "3600"))
+            step_s = float(q.get("step", "60"))
+        except ValueError as exc:
+            raise BadRequest(f"bad window/step: {exc}")
+        if window_s <= 0:
+            raise BadRequest("window must be > 0 seconds")
+        out = {}
+        for name in (n.strip() for n in names.split(",")):
+            if not name:
+                continue
+            out[name] = self.telemetry.query(
+                name, window_ms=int(window_s * 1000),
+                step_ms=int(step_s * 1000))
+        return 200, {"windowMs": int(window_s * 1000),
+                     "stepMs": int(step_s * 1000), "series": out}, {}
+
+    def _ep_stream(self, q):
+        """Incremental point stream, resumable by sequence number (the
+        cursor discipline documented in the module docstring above).  Body
+        is JSON lines; ``X-Stream-Cursor`` carries the next ``since`` and
+        ``X-Stream-Truncated: true`` means the client fell behind the ring
+        and must re-sync from ``/timeseries``."""
+        try:
+            since = int(q.get("since", "0"))
+            limit = int(q.get("limit", "1000"))
+        except ValueError as exc:
+            raise BadRequest(f"bad since/limit: {exc}")
+        if since < 0 or limit <= 0:
+            raise BadRequest("since must be >= 0 and limit > 0")
+        events, cursor, truncated = self.telemetry.stream_since(since, limit)
+        body = "".join(json.dumps(e, sort_keys=True) + "\n" for e in events)
+        return 200, PlainText(body), {
+            "X-Stream-Cursor": str(cursor),
+            "X-Stream-Truncated": "true" if truncated else "false"}
 
     def _ep_trace(self, q):
         """Finished operation traces.  ``?task_id=`` returns the span tree

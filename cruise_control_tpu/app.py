@@ -444,12 +444,18 @@ class KafkaCruiseControlApp:
         # Sensor/state updater (LoadMonitor.java:177-179 sensor updater
         # thread): refreshes the monitored-percentage cache at
         # monitor.state.update.interval.ms so /metrics gauges stay fresh
-        # without an inbound request.
+        # without an inbound request.  The same cadence bridges the
+        # heal/standing-hit counter families into the telemetry
+        # time-series store, so /timeseries answers over them with
+        # history instead of only the current cumulative value.
         def state_updater_loop():
+            from cruise_control_tpu.common.timeseries import (
+                SENSOR_SAMPLE_FAMILIES, TELEMETRY)
             wait_s = cfg.get(C.MONITOR_STATE_UPDATE_INTERVAL_MS_CONFIG) / 1000.0
             while not self._stop.is_set():
                 try:
                     self.load_monitor.monitored_partitions_percentage()
+                    TELEMETRY.sample_sensors(SENSOR_SAMPLE_FAMILIES)
                 except Exception:  # noqa: BLE001
                     pass
                 self._stop.wait(wait_s)

@@ -19,10 +19,14 @@ from __future__ import annotations
 import dataclasses
 import heapq
 import threading
+import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from cruise_control_tpu.common.sensors import SENSORS
+from cruise_control_tpu.common.timeseries import (HEAL_DURATION_SERIES,
+                                                  HEAL_STARTED_SERIES,
+                                                  TELEMETRY)
 from cruise_control_tpu.common.tracing import TRACE
 from cruise_control_tpu.detector.anomalies import Anomaly, AnomalyType
 from cruise_control_tpu.detector.notifier import (AnomalyNotificationAction,
@@ -168,6 +172,20 @@ class AnomalyDetectorManager:
             for a in anomalies:
                 self.enqueue(a, now_ms)
                 found += 1
+        # Detector-tick publish boundary: the finding count and the
+        # goal-violation detector's rolling balancedness (a cached host
+        # float — its sweep already ran inside detect()) become series
+        # points stamped with the tick's own clock.
+        TELEMETRY.record("detector.anomalies-found", float(found),
+                         t_ms=now_ms)
+        score = self.balancedness_score()
+        if score is not None and score >= 0.0:
+            # Negative is the offline-replicas sentinel
+            # (BALANCEDNESS_SCORE_WITH_OFFLINE_REPLICAS): the score is
+            # *undefined* during a failure window, not low — publishing it
+            # would poison the SLA floor, so the series simply has a gap
+            # there (the heal series carries the failure evidence).
+            TELEMETRY.record("detector.balancedness", score, t_ms=now_ms)
         return found
 
     def handle_anomalies_once(self, now_ms: int) -> int:
@@ -184,6 +202,8 @@ class AnomalyDetectorManager:
                 handled += self._handle(entry.anomaly, now_ms)
             for entry in deferred:
                 heapq.heappush(self._queue, entry)
+        TELEMETRY.record("detector.anomalies-handled", float(handled),
+                         t_ms=now_ms)
         return handled
 
     def _handle(self, anomaly: Anomaly, now_ms: int) -> int:  # holds-lock: _lock
@@ -211,6 +231,7 @@ class AnomalyDetectorManager:
             return 1
         started = False
         if self._facade is not None:
+            heal_t0 = time.monotonic()
             self.state.ongoing_self_healing = anomaly.reason()
             # A raising fix() must behave like a failed one: clear the
             # ongoing flag, record FIX_FAILED_TO_START, and keep draining
@@ -227,6 +248,13 @@ class AnomalyDetectorManager:
                     self.state.ongoing_self_healing = None
                 sp.annotate(started=started)
             (self._heals_started if started else self._heals_failed).inc()
+            # Heal publish boundary: latency (detect→dispatch wall, the
+            # same value the heal histogram observed) and the outcome flag
+            # the SLA rollup's all-heals-completed check reads.
+            TELEMETRY.record(HEAL_DURATION_SERIES,
+                             time.monotonic() - heal_t0, t_ms=now_ms)
+            TELEMETRY.record(HEAL_STARTED_SERIES,
+                             1.0 if started else 0.0, t_ms=now_ms)
         self.state.update_status(
             anomaly, "FIX_STARTED" if started else "FIX_FAILED_TO_START", now_ms)
         return 1

@@ -29,6 +29,11 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 from cruise_control_tpu.common.sensors import SENSORS
+from cruise_control_tpu.common.timeseries import (REPLAN_ADDED_SERIES,
+                                                  REPLAN_CANCELLED_SERIES,
+                                                  REPLAN_KEPT_SERIES,
+                                                  TASK_DURATION_SERIES,
+                                                  TELEMETRY)
 from cruise_control_tpu.executor.task import ExecutionTask, TaskState, TaskType
 
 #: Checkpoint ring target: when full, thin to every other checkpoint and
@@ -125,9 +130,15 @@ class ExecutionLedger:
         partition that the new plan moves again is live work once more).
         Cancellations arrive separately through observe() as
         PENDING→ABORTED transitions."""
-        self.replans.append({"tMs": self._clock_ms(), "poll": self.polls,
+        now = self._clock_ms()
+        self.replans.append({"tMs": now, "poll": self.polls,
                              "cancelled": cancelled, "kept": kept,
                              "added": len(added_tasks)})
+        # Replan publish boundary: the churn triple the SLA rollup's
+        # cancelled/kept/added ratio is computed from.
+        TELEMETRY.record(REPLAN_CANCELLED_SERIES, cancelled, t_ms=now)
+        TELEMETRY.record(REPLAN_KEPT_SERIES, kept, t_ms=now)
+        TELEMETRY.record(REPLAN_ADDED_SERIES, len(added_tasks), t_ms=now)
         if scorer is not None:
             self._scorer = scorer
         for t in added_tasks:
@@ -161,6 +172,9 @@ class ExecutionLedger:
                 labels={"type": task.task_type.value},
                 help="Completed execution task duration, by task type"
             ).observe(max(0, task.end_time_ms - task.start_time_ms) / 1000.0)
+            TELEMETRY.record(TASK_DURATION_SERIES,
+                             max(0, task.end_time_ms - task.start_time_ms),
+                             t_ms=now_ms)
             self._land(task.proposal.partition)
         elif new_state in (TaskState.ABORTED, TaskState.DEAD):
             if old_state in (TaskState.IN_PROGRESS, TaskState.ABORTING):
@@ -249,6 +263,13 @@ class ExecutionLedger:
         if self._scorer is not None:
             cp["_landed_set"] = frozenset(self._landed)
         self.checkpoints.append(cp)
+        # Checkpoint publish boundary: the progress curve's host scalars
+        # (the balancedness point lands later, in score_checkpoints — the
+        # batched phase-boundary scoring keeps this path fetch-free).
+        TELEMETRY.record("executor.bytes-moved", self.bytes_moved,
+                         t_ms=cp["tMs"])
+        TELEMETRY.record("executor.off-target-bytes", cp["offTargetBytes"],
+                         t_ms=cp["tMs"])
         if len(self.checkpoints) > self._max_checkpoints:
             self.checkpoints = self.checkpoints[::2]
             self._stride *= 2
@@ -268,6 +289,11 @@ class ExecutionLedger:
         for cp, s in zip(pending, scores):
             cp["balancedness"] = float(s)
             del cp["_landed_set"]
+            # Scored at the phase boundary, stamped with the checkpoint's
+            # own (possibly virtual) time — the SLA balancedness series'
+            # executor-side source.
+            TELEMETRY.record("executor.balancedness", float(s),
+                             t_ms=cp["tMs"])
 
     # -- derived metrics -----------------------------------------------------
     @property

@@ -228,6 +228,24 @@ def exercise(api, mgr) -> None:
     if not mgr.handle_anomalies_once(now_ms=2):
         print("warning: heal-pipeline exercise handled no anomaly",
               file=sys.stderr)
+    # Telemetry time-series store: sample the sensor bridge (which ticks
+    # the store and registers the Telemetry.* accounting gauges) and answer
+    # one /timeseries and one /stream read — so the store's sensor family
+    # lands in the drift-checked catalog alongside the surfaces that
+    # publish into it.
+    from cruise_control_tpu.common.timeseries import (SENSOR_SAMPLE_FAMILIES,
+                                                      TELEMETRY)
+    TELEMETRY.sample_sensors(SENSOR_SAMPLE_FAMILIES)
+    for method, endpoint, query in [
+        ("GET", "timeseries", {}),
+        ("GET", "timeseries", {"series": "detector.balancedness",
+                               "window": "3600", "step": "60"}),
+        ("GET", "stream", {"since": "0"}),
+    ]:
+        status, _, _ = api.handle(method, endpoint, query)
+        if status >= 400:
+            print(f"warning: {method} /{endpoint} -> {status}",
+                  file=sys.stderr)
 
 
 def catalog_markdown(catalog) -> str:

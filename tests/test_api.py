@@ -66,10 +66,13 @@ def test_endpoint_inventory():
     # HTTP-reachable in a JVM-free service), /trace (span traces of admin
     # operations, keyed by user task), /flight (the solve flight
     # recorder's per-step convergence timelines, cut from those traces),
-    # and /executor_state (the execution ledger's progress/curve surface —
-    # the reference folds this into /state's executor substate).
+    # /executor_state (the execution ledger's progress/curve surface —
+    # the reference folds this into /state's executor substate), and
+    # /timeseries + /stream (the telemetry store's bucketed history and
+    # resumable incremental tail — the reference leaves history to JMX
+    # scrapers).
     assert len(GET_ENDPOINTS - {"metrics", "trace", "flight",
-                                "executor_state"}) \
+                                "executor_state", "timeseries", "stream"}) \
         + len(POST_ENDPOINTS) == 20
 
 

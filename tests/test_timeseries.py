@@ -1,0 +1,270 @@
+"""Telemetry time-series store tests: ring retention and staged
+downsampling vs a naive recompute, stream cursor resume, SLA rollup math
+on a canned fixture, byte-budget admission under a write flood, and the
+no-new-fetch-sites contract for the read path.
+"""
+
+import json
+
+import pytest
+
+from cruise_control_tpu.common.timeseries import (
+    DEFAULT_RUNGS, HEAL_DURATION_SERIES, HEAL_STARTED_SERIES,
+    REPLAN_ADDED_SERIES, REPLAN_CANCELLED_SERIES, REPLAN_KEPT_SERIES,
+    STANDING_HIT_SERIES, TASK_DURATION_SERIES, TimeSeriesStore)
+
+
+def make_store(**kw):
+    kw.setdefault("raw_capacity", 64)
+    kw.setdefault("rungs", DEFAULT_RUNGS)
+    kw.setdefault("stream_capacity", 256)
+    kw.setdefault("byte_budget", 10_000_000)
+    return TimeSeriesStore(**kw)
+
+
+# -- ring retention & staged downsampling --------------------------------
+
+def naive_buckets(points, step_ms, lo, hi):
+    """Ground truth: bucket the raw points directly at ``step_ms``."""
+    out = {}
+    for t, v in points:
+        if not (lo <= t <= hi):
+            continue
+        key = (t // step_ms) * step_ms
+        b = out.setdefault(key, [0, 0.0, float("inf"), float("-inf"), None])
+        b[0] += 1
+        b[1] += v
+        b[2] = min(b[2], v)
+        b[3] = max(b[3], v)
+        b[4] = v
+    return {k: {"count": c, "sum": s, "min": mn, "max": mx, "last": last}
+            for k, (c, s, mn, mx, last) in sorted(out.items())}
+
+
+def test_raw_ring_retention():
+    st = make_store(raw_capacity=16)
+    for i in range(40):
+        st.record("s", float(i), t_ms=i * 1000)
+    # Raw query returns only the retained tail, newest-complete.
+    rows = st.query("s", window_ms=60_000, step_ms=0, now_ms=39_000)
+    assert len(rows) == 16
+    assert [r["last"] for r in rows] == [float(i) for i in range(24, 40)]
+    # Every eviction was counted as a drop.
+    assert st.points_dropped == 40 - 16
+    assert st.points_total == 40
+
+
+def test_staged_rungs_agree_with_naive_recompute():
+    # Irregular cadence + irregular values across > 1 h so both rungs
+    # (10 s and 1 m) seal plenty of buckets.
+    st = make_store(raw_capacity=8)  # tiny raw ring: rungs must carry it
+    points = []
+    t = 0
+    for i in range(1200):
+        t += 500 + (i * 37) % 9500          # 0.5–10 s apart
+        v = ((i * 7919) % 1000) / 10.0 - 30.0
+        points.append((t, v))
+        st.record("s", v, t_ms=t)
+    hi = t
+    for step_s in (10, 60, 120):            # rung-aligned and regrouped
+        step = step_s * 1000
+        rows = st.query("s", window_ms=hi + 1, step_ms=step, now_ms=hi)
+        got = {r["tMs"]: r for r in rows}
+        # The store's retention is bounded: compare over the span the
+        # finest serving rung actually retained (first returned bucket on).
+        assert rows, f"no rows at step {step_s}s"
+        lo = rows[0]["tMs"]
+        want = naive_buckets(points, step, lo, hi)
+        assert set(got) == set(want)
+        for key, w in want.items():
+            g = got[key]
+            assert g["count"] == w["count"], (step_s, key)
+            assert g["sum"] == pytest.approx(w["sum"]), (step_s, key)
+            assert g["min"] == w["min"] and g["max"] == w["max"], (step_s, key)
+            assert g["last"] == w["last"], (step_s, key)
+            assert g["mean"] == pytest.approx(w["sum"] / w["count"])
+
+
+def test_downsample_step_picks_finest_sufficient_rung():
+    st = make_store()
+    for i in range(100):
+        st.record("s", float(i), t_ms=i * 5_000)  # 0..495 s
+    # step below the first rung serves raw points.
+    raw = st.query("s", window_ms=600_000, step_ms=1_000, now_ms=495_000)
+    assert all(r["count"] == 1 for r in raw)
+    # step 30 s regroups the 10 s rung: 6 points per bucket.
+    rows = st.query("s", window_ms=600_000, step_ms=30_000, now_ms=495_000)
+    interior = rows[1:-1]
+    assert interior and all(r["count"] == 6 for r in interior)
+
+
+# -- stream cursor resume -------------------------------------------------
+
+def test_stream_cursor_resume_no_gaps_no_duplicates():
+    st = make_store(stream_capacity=1024)
+    for i in range(50):
+        st.record("a" if i % 2 else "b", float(i), t_ms=i)
+    seen = []
+    cursor, rounds = 0, 0
+    while True:
+        events, cursor2, truncated = st.stream_since(cursor, limit=7)
+        assert not truncated
+        if not events:
+            break
+        assert events[0]["seq"] == cursor + 1  # no gap at the resume point
+        seen.extend(e["seq"] for e in events)
+        cursor = cursor2
+        rounds += 1
+    assert seen == list(range(1, 51))  # exactly once, in order
+    assert rounds == 8  # ceil(50/7): limit respected
+
+
+def test_stream_truncation_flags_fallen_behind_reader():
+    st = make_store(stream_capacity=16)
+    for i in range(100):
+        st.record("s", float(i), t_ms=i)
+    events, cursor, truncated = st.stream_since(0, limit=1000)
+    assert truncated  # seqs 1..84 aged out of the ring
+    assert events[0]["seq"] == 85 and events[-1]["seq"] == 100
+    assert cursor == 100
+    # A reader that resumes from the returned cursor is whole again.
+    st.record("s", 1.0, t_ms=101)
+    events2, _, truncated2 = st.stream_since(cursor, limit=10)
+    assert not truncated2 and [e["seq"] for e in events2] == [101]
+
+
+def test_stream_events_are_json_lines_material():
+    st = make_store()
+    st.record("s", 1.5, t_ms=10)
+    events, _, _ = st.stream_since(0)
+    line = json.dumps(events[0], sort_keys=True)
+    assert json.loads(line) == {"seq": 1, "series": "s", "tMs": 10,
+                                "value": 1.5}
+
+
+# -- SLA rollup math on a canned fixture ----------------------------------
+
+def canned_store():
+    st = make_store()
+    # Balancedness: floor 62, rest high.
+    for i, v in enumerate([95.0, 90.0, 62.0, 88.0, 99.0, 97.0]):
+        st.record("detector.balancedness", v, t_ms=(i + 1) * 60_000)
+    # Heals: latencies 2/4/6 s; two started, one failed.
+    for i, (lat, ok) in enumerate([(2.0, 1.0), (4.0, 0.0), (6.0, 1.0)]):
+        st.record(HEAL_DURATION_SERIES, lat, t_ms=(i + 1) * 100_000)
+        st.record(HEAL_STARTED_SERIES, ok, t_ms=(i + 1) * 100_000)
+    # Task durations ms.
+    for i, d in enumerate([100.0, 200.0, 300.0, 400.0]):
+        st.record(TASK_DURATION_SERIES, d, t_ms=(i + 1) * 50_000)
+    # Replan churn: two replans over a 10-move plan.
+    st.record(REPLAN_CANCELLED_SERIES, 3.0, t_ms=150_000)
+    st.record(REPLAN_KEPT_SERIES, 7.0, t_ms=150_000)
+    st.record(REPLAN_ADDED_SERIES, 2.0, t_ms=150_000)
+    st.record(REPLAN_CANCELLED_SERIES, 1.0, t_ms=250_000)
+    st.record(REPLAN_KEPT_SERIES, 5.0, t_ms=250_000)
+    st.record(REPLAN_ADDED_SERIES, 0.0, t_ms=250_000)
+    # Standing hits: 3 of 4 cruise ticks were hits.
+    for i, hit in enumerate([1.0, 1.0, 0.0, 1.0]):
+        st.record(STANDING_HIT_SERIES, hit, t_ms=(i + 1) * 80_000)
+    # Fetches per boundary: pinned at 0 except one cold tick.
+    for i, n in enumerate([0.0, 0.0, 4.0, 0.0]):
+        st.record("cruise.fetches-per-boundary", n, t_ms=(i + 1) * 80_000)
+    return st
+
+
+def test_sla_rollup_math():
+    st = canned_store()
+    sla = st.sla(window_ms=400_000, now_ms=400_000)
+    bal = sla["balancedness"]
+    assert bal["floor"] == 62.0
+    assert bal["samples"] == 6
+    assert bal["last"] == 97.0
+    assert bal["p50"] == 90.0  # nearest-rank over (62,88,90,95,97,99)
+    assert bal["p99"] == 99.0
+    heal = sla["healLatencySeconds"]
+    assert heal["count"] == 3
+    assert heal["mean"] == pytest.approx(4.0)
+    assert heal["max"] == 6.0
+    assert sla["healsStarted"] == 2 and sla["healsFailed"] == 1
+    td = sla["taskDurationMs"]
+    assert td["count"] == 4 and td["mean"] == pytest.approx(250.0)
+    churn = sla["replanChurn"]
+    assert churn["replans"] == 2
+    assert churn["cancelled"] == 4 and churn["kept"] == 12
+    assert churn["added"] == 2
+    # churnRatio = (cancelled + added) / (cancelled + kept + added): 6/18.
+    assert churn["churnRatio"] == pytest.approx(6.0 / 18.0)
+    assert sla["standingHitRatio"] == pytest.approx(0.75)
+    assert sla["fetchesPerBoundary"]["mean"] == pytest.approx(1.0)
+    assert sla["store"]["bytes"] <= sla["store"]["budget"]
+
+
+def test_sla_window_excludes_older_points():
+    st = canned_store()
+    # lo = 230 000: only the 240/300/360 s balancedness points qualify.
+    sla = st.sla(window_ms=130_000, now_ms=360_000)
+    assert sla["balancedness"]["samples"] == 3
+    assert sla["balancedness"]["floor"] == 88.0
+
+
+def test_sla_floor_survives_raw_ring_aging():
+    # The floor must come from rung minima once the raw ring evicts the
+    # minimum — min-of-mins is exact across the staged downsample.
+    st = make_store(raw_capacity=8)
+    st.record("detector.balancedness", 10.0, t_ms=1_000)  # the true floor
+    for i in range(50):  # push the floor point out of the raw ring
+        st.record("detector.balancedness", 90.0 + (i % 5),
+                  t_ms=10_000 + i * 10_000)
+    sla = st.sla(window_ms=600_000, now_ms=510_000)
+    assert sla["balancedness"]["floor"] == 10.0
+
+
+# -- byte budget under a write flood --------------------------------------
+
+def test_byte_budget_never_exceeded_under_flood():
+    # Small rungs so one series' worst case (~6 KB) fits the 60 KB budget
+    # a handful of times — the flood must see both admissions and refusals.
+    st = make_store(raw_capacity=32, stream_capacity=64, byte_budget=60_000,
+                    rungs=((10_000, 16), (60_000, 8)))
+    admitted, rejected = 0, 0
+    for i in range(5_000):
+        # 200 distinct series names: most must be refused admission.
+        ok = st.record(f"flood.{i % 200}", float(i), t_ms=i * 10)
+        admitted += ok
+        rejected += not ok
+        if i % 500 == 0:
+            assert st.store_bytes() <= st.byte_budget()
+    assert rejected > 0, "flood never hit the budget — raise the flood"
+    assert admitted > 0, "budget rejected everything — floor too low"
+    assert st.store_bytes() <= st.byte_budget()
+    assert st.committed_bytes() <= st.byte_budget()
+    # Rejections and ring evictions are both visible drops.
+    assert st.points_dropped >= rejected
+    # Existing series keep accepting after the budget closed to new ones.
+    assert st.record("flood.0", 1.0, t_ms=10_000_000)
+
+
+def test_accounting_pair_tracks_totals():
+    st = make_store(raw_capacity=16)
+    for i in range(100):
+        st.record("s", float(i), t_ms=i)
+    assert st.points_total == 100
+    assert st.points_dropped == 100 - 16  # raw-ring evictions
+    st2 = make_store(byte_budget=1)  # nothing fits
+    assert not st2.record("s", 1.0, t_ms=0)
+    assert st2.points_dropped == 1 and st2.points_total == 0
+
+
+# -- hot-path contract: the read path never fetches -----------------------
+
+def test_no_new_fetch_sites_for_telemetry():
+    """The telemetry store and its API read path are pure host work: no
+    entry in the lint contract's FETCH_SITES whitelist points at them, and
+    none was needed — a device fetch creeping into /timeseries or /stream
+    would fail cruise-lint's implicit-sync rule, not grow the whitelist."""
+    from tools.lint.contracts import FETCH_SITES
+    for path, _fn in FETCH_SITES:
+        assert "timeseries" not in path
+        assert not path.endswith("api/server.py"), (
+            "the API server must stay fetch-free; FETCH_SITES grew an "
+            f"entry for {path}")

@@ -1,0 +1,182 @@
+"""Render an SLA soak artifact's timeline; re-validate its invariants.
+
+The SLA soak (``python bench.py --sla``) drives the full service loop —
+cruise refresh, detector tick, live replanner, executor — through >=1 hour
+of virtual churn and commits the telemetry store's rollups as
+``SLA_<rung>.json``.  This tool turns that artifact into something a human
+(ASCII balancedness timeline with death/heal markers + rollup tables) or a
+later revision (``--json`` one-liner) can read, and it re-checks the
+rung's invariants FROM THE ARTIFACT — a stale or hand-edited file that no
+longer passes its own gates fails here, not in a later comparison:
+
+- ``python tools/sla_report.py SLA_mid.json``   render the timeline
+- ``--json`` emits the report (including ``invariants``) as one JSON line.
+
+Invariants re-derived from the artifact (not trusted from ``gates``):
+virtual span >= 1 h; the committed floor matches the timeline's minimum;
+every recorded death carries a healed tick; resident store bytes within
+budget; every API probe answered with device-fetch counters flat.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_BAR_W = 40
+
+
+def build_report(record: dict) -> dict:
+    if "sla" not in record or "timeline" not in record:
+        raise SystemExit("unrecognized record: need an SLA_*.json artifact "
+                         "(bench.py --sla) with 'sla' and 'timeline'")
+    sla = record["sla"]
+    timeline = list(record["timeline"])
+    deaths = list(record.get("deaths", []))
+    probes = dict(record.get("probes", {}))
+    store = dict(record.get("store", {}))
+    bal = sla.get("balancedness") or {}
+    mins = [b["min"] for b in timeline if b.get("min") is not None]
+    floor = record.get("value")
+    invariants = {
+        "virtual_span_ge_1h": float(record.get("virtual_span_s", 0)) >= 3600,
+        # The headline floor must agree with the committed timeline: the
+        # rollup engine and a naive recompute over the downsampled buckets
+        # see the same minimum (staged rungs keep min-of-mins exact).
+        "floor_matches_timeline": bool(mins) and floor is not None
+        and abs(min(mins) - floor) < 1e-9,
+        "floor_above_threshold": floor is not None
+        and floor >= float(record.get("floor_threshold", 0.0)),
+        "all_deaths_healed": bool(deaths)
+        and all("healed_tick" in d for d in deaths),
+        "store_within_budget": store.get("bytes", 0) <= store.get(
+            "budget", 0),
+        "api_probes_fetch_flat": probes.get("count", 0) > 0
+        and bool(probes.get("fetch_flat")),
+    }
+    return {
+        "source": record.get("metric", "sla_artifact"),
+        "floor": floor,
+        "floor_threshold": record.get("floor_threshold"),
+        "virtual_span_s": record.get("virtual_span_s"),
+        "host_wall_s": record.get("host_wall_s"),
+        "num_brokers": record.get("num_brokers"),
+        "deaths": deaths,
+        "heal_latency": sla.get("healLatencySeconds"),
+        "task_duration": sla.get("taskDurationMs"),
+        "replan_churn": sla.get("replanChurn"),
+        "standing_hit_ratio": sla.get("standingHitRatio"),
+        "fetches_per_boundary": sla.get("fetchesPerBoundary"),
+        "balancedness": bal,
+        "timeline": timeline,
+        "probes": probes,
+        "store": store,
+        "invariants": invariants,
+        "ok": all(invariants.values()),
+    }
+
+
+def _bar(v: float, vmax: float) -> str:
+    if vmax <= 0:
+        return ""
+    return "#" * max(1 if v > 0 else 0, round(_BAR_W * v / vmax))
+
+
+def _dist_line(name: str, d: dict) -> str:
+    return (f"  {name:<22} n={d.get('count', 0):<5} "
+            f"mean={d.get('mean', 0):.3f} p50={d.get('p50', 0):.3f} "
+            f"p99={d.get('p99', 0):.3f} max={d.get('max', 0):.3f}")
+
+
+def print_report(rep: dict) -> None:
+    print(f"source={rep['source']} brokers={rep['num_brokers']} "
+          f"virtual_span={rep['virtual_span_s']:.0f}s "
+          f"host_wall={rep['host_wall_s']:.0f}s")
+    print(f"balancedness floor={rep['floor']:.3f} "
+          f"(threshold {rep['floor_threshold']}) "
+          f"p50={rep['balancedness'].get('p50', 0):.3f} "
+          f"p99={rep['balancedness'].get('p99', 0):.3f}")
+    print()
+    # Timeline: one row per downsample bucket, the bar is the bucket's MIN
+    # balancedness (the SLA-relevant envelope); death/heal markers
+    # interleave by virtual time.
+    events = []
+    for d in rep["deaths"]:
+        events.append((d.get("killed_t_ms", 0),
+                       f"death broker={d['victim']} "
+                       f"healed_after={d.get('heal_latency_s', '?')}s "
+                       f"(transfer {d.get('fleet_transfer_s', '?')}s)"))
+    events.sort()
+    ei = 0
+    print(f"{'t(min)':>8} {'min':>6} {'mean':>6}  balancedness (bucket min)")
+    for b in rep["timeline"]:
+        t = b.get("tMs", 0)
+        while ei < len(events) and events[ei][0] <= t:
+            print(f"{'---':>8} {events[ei][1]}")
+            ei += 1
+        mn, mean = b.get("min"), b.get("mean")
+        if mn is None:
+            continue
+        print(f"{t / 60000.0:>8.1f} {mn:>6.1f} {mean:>6.1f}  "
+              f"{_bar(mn, 100.0)}")
+    for _, msg in events[ei:]:
+        print(f"{'---':>8} {msg}")
+    print()
+    for name, key in (("heal latency (s)", "heal_latency"),
+                      ("task duration (ms)", "task_duration"),
+                      ("fetches/boundary", "fetches_per_boundary")):
+        if rep.get(key):
+            print(_dist_line(name, rep[key]))
+    churn = rep.get("replan_churn")
+    if churn:
+        print(f"  {'replan churn':<22} replans={churn.get('replans', 0)} "
+              f"cancelled={churn.get('cancelled', 0)} "
+              f"kept={churn.get('kept', 0)} added={churn.get('added', 0)} "
+              f"ratio={churn.get('churnRatio', 0):.3f}")
+    if rep.get("standing_hit_ratio") is not None:
+        print(f"  {'standing-hit ratio':<22} {rep['standing_hit_ratio']:.3f}")
+    store = rep["store"]
+    print(f"  {'store':<22} bytes={store.get('bytes', 0)} / "
+          f"budget={store.get('budget', 0)} "
+          f"series={store.get('series', 0)} "
+          f"dropped={store.get('points_dropped', 0)}")
+    probes = rep["probes"]
+    print(f"  {'api probes':<22} count={probes.get('count', 0)} "
+          f"stream_events={probes.get('stream_events', 0)} "
+          f"fetch_flat={probes.get('fetch_flat')}")
+    print()
+    for name, ok in rep["invariants"].items():
+        print(f"invariant {name}: {'ok' if ok else 'FAILED'}")
+    if not rep["ok"]:
+        raise SystemExit("SLA artifact failed invariant re-validation")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("record", help="SLA_*.json artifact (bench.py --sla)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as one JSON line (no timeline)")
+    args = ap.parse_args()
+    with open(args.record) as f:
+        text = f.read().strip()
+    try:
+        record = json.loads(text)
+    except json.JSONDecodeError:
+        # bench output is .jsonl (one record per line, last wins)
+        record = json.loads(text.splitlines()[-1])
+    rep = build_report(record)
+    if args.json:
+        rep = dict(rep, timeline=len(rep["timeline"]))
+        print(json.dumps(rep), flush=True)
+        if not rep["ok"]:
+            raise SystemExit(1)
+    else:
+        print_report(rep)
+
+
+if __name__ == "__main__":
+    main()
