@@ -313,6 +313,190 @@ def run_rung(scale: str, max_candidates, fast: bool) -> dict:
     return rec
 
 
+def run_mesh_rung(scale: str, max_candidates, fast: bool) -> dict:
+    """--mesh: GSPMD parity twin rung, run in a SUBPROCESS on an 8-device
+    virtual CPU mesh (the XLA_FLAGS device-count override must precede
+    backend init, which this process has already done — hence the child).
+    The child solves the rung's full stack single-device AND
+    replica-axis-sharded from the same snapshot, enforces proposal
+    bit-identity + equisatisfaction in-rung (and that compaction AND the
+    speculative double-buffer actually engage under GSPMD), writes
+    MESH_<rung>.json, and prints one JSON line this parent re-emits."""
+    env = dict(os.environ, BENCH_MESH_CHILD="1", JAX_PLATFORMS="cpu")
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    env.pop("BENCH_T0", None)  # the child is budgeted by this rung's watchdog
+    deadline = max(60.0, _budget_remaining() - 30.0)
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--mesh",
+         "--rungs", scale],
+        env=env, capture_output=True, text=True, timeout=deadline)
+    sys.stderr.write(out.stderr[-4000:])
+    sys.stderr.flush()
+    if out.returncode != 0:
+        raise SystemExit(f"mesh child rung failed rc={out.returncode}: "
+                         f"{out.stderr[-500:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def run_mesh_child(scale: str, max_candidates, fast: bool) -> dict:
+    """The --mesh twin's child body (``BENCH_MESH_CHILD=1``, 8 virtual CPU
+    devices): full-stack single-device-vs-sharded parity at the rung's
+    scale.  In-rung gates:
+
+      - proposal BIT-IDENTITY: the sharded solve must land the exact
+        placement the single-device solve lands.  ns/nd are pinned to
+        multiples of the mesh size so the lane rounding in
+        ``_frontier_widths`` is the identity — both flavors dispatch the
+        SAME candidate widths and bit-identity is structural, not lucky;
+      - equisatisfaction + verifier-clean sharded proposals;
+      - compaction buckets AND speculative dispatch actually engage under
+        GSPMD (a parity run that never compacts would prove nothing about
+        the sharded bucket path).
+
+    The production dense floor (64 brokers) sits above the mid rung's
+    broker axis, so the child lowers it for BOTH flavors identically
+    (``BENCH_MESH_DENSE_MIN``, default 16) — the frontier tests' scale-down
+    trick.  ``segment_steps=8`` keeps chunks short so goals cross several
+    boundaries and speculation has boundaries to hide.  AOT prelowering is
+    on in the child so the dispatched HLO is in hand and the per-shard
+    collective counts land in the chunk records (the ``coll`` column in
+    tools/dispatch_report.py)."""
+    brokers, racks, topics, ppt, rf = SCALES[scale]
+
+    import jax
+    import numpy as np
+
+    from cruise_control_tpu.analyzer import optimizer as opt
+    from cruise_control_tpu.analyzer import proposals as props
+    from cruise_control_tpu.analyzer.verifier import verify_run
+    from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster
+    from cruise_control_tpu.parallel import mesh as pmesh
+
+    if jax.default_backend() != "cpu" or len(jax.devices()) < 8:
+        raise SystemExit(
+            "mesh child needs the 8-device virtual CPU mesh "
+            "(XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+    opt._FRONTIER_DENSE_MIN = int(os.environ.get("BENCH_MESH_DENSE_MIN",
+                                                 "16"))
+    os.environ.setdefault("CRUISE_AOT_PRELOWER", "1")
+
+    spec = ClusterSpec(num_brokers=brokers, num_racks=racks, num_topics=topics,
+                       mean_partitions_per_topic=ppt, replication_factor=rf,
+                       distribution="exponential", seed=2026)
+    model = jax.device_put(generate_cluster(spec, pad_replicas_to_multiple=8))
+    jax.block_until_ready(model)
+    num_replicas = int(model.replica_valid.sum())
+    ns, nd = 32, 8  # multiples of the mesh size: lane rounding is identity
+
+    def solve(mesh=None):
+        m = (model if mesh is None
+             else pmesh.shard_model_replica_axis(model, mesh))
+        kw = dict(raise_on_hard_failure=False, fused=True, fuse_group_size=1,
+                  segment_steps=8, pipeline=True, num_sources=ns,
+                  num_dests=nd, max_candidates_per_step=max_candidates,
+                  fast_mode=fast, mesh=mesh)
+        opt.optimize(m, STACK, **kw)  # warm-up compiles this flavor
+        disp0 = dict(opt.FETCH_COUNTERS)
+        t0 = time.monotonic()
+        run = opt.optimize(m, STACK, **kw)
+        wall = time.monotonic() - t0
+        fetches = {k: opt.FETCH_COUNTERS[k] - disp0[k] for k in disp0}
+        return run, wall, fetches
+
+    ref_run, ref_wall, ref_f = solve()
+    mesh = pmesh.make_search_mesh()
+    got_run, got_wall, got_f = solve(mesh)
+
+    identical = all(
+        np.array_equal(np.asarray(getattr(ref_run.model, f)),
+                       np.asarray(getattr(got_run.model, f)))
+        for f in ("replica_broker", "replica_is_leader", "replica_disk"))
+    if not identical:
+        raise SystemExit(
+            f"sharded placement diverged from single-device on rung {scale}")
+    for r, g in zip(ref_run.goal_results, got_run.goal_results):
+        if (r.steps, r.actions_applied) != (g.steps, g.actions_applied):
+            raise SystemExit(
+                f"per-goal trajectory diverged on {r.name}: "
+                f"single=({r.steps},{r.actions_applied}) "
+                f"sharded=({g.steps},{g.actions_applied})")
+    ref_sat = {g.name: g.satisfied_after for g in ref_run.goal_results}
+    got_sat = {g.name: g.satisfied_after for g in got_run.goal_results}
+    equisat = all(got_sat[name] for name, ok in ref_sat.items() if ok)
+    if not equisat:
+        raise SystemExit(
+            f"sharded solve under-satisfied vs single-device on rung "
+            f"{scale}: single={ref_sat} sharded={got_sat}")
+    got_props = props.diff(model, got_run.model)
+    verify_run(model, got_run, [g.name for g in got_run.goal_results],
+               proposals=got_props)
+
+    buckets = sorted({c.get("bucket") for g in got_run.goal_results
+                      for c in (g.chunks or []) if c.get("bucket")})
+    spec_chunks = sum(g.chunks_speculative for g in got_run.goal_results)
+    if not buckets:
+        raise SystemExit("mesh rung: compaction never engaged under GSPMD")
+    if spec_chunks <= 0:
+        raise SystemExit("mesh rung: speculation never engaged under GSPMD")
+
+    def side(run, wall, fetches):
+        chunks = [c for g in run.goal_results for c in (g.chunks or [])]
+        return {
+            "wall_s": round(wall, 3),
+            "steps": sum(g.steps for g in run.goal_results),
+            "actions": sum(g.actions_applied for g in run.goal_results),
+            "fetches": fetches["device_fetches"],
+            "chunks_dispatched": fetches["chunks_dispatched"],
+            "fetch_bytes": sum(int(c.get("fetch_bytes", 0) or 0)
+                               for c in chunks),
+            "collectives": sum(int(c.get("collectives") or 0)
+                               for c in chunks),
+        }
+
+    rec = {
+        "metric": f"mesh_stack_parity_{scale}",
+        "value": round(got_wall, 3),
+        "unit": "s",
+        # Parity is the bar, not wall: 8 virtual devices on one CPU core
+        # model the partitioning, not the speedup.
+        "vs_baseline": 1.0 if identical and equisat else 0.0,
+        "num_brokers": brokers,
+        "num_replicas": num_replicas,
+        "mesh_devices": len(jax.devices()),
+        "num_proposals": len(got_props),
+        "bit_identical": identical,
+        "equisatisfying": equisat,
+        "buckets": buckets,
+        "chunks_speculative": spec_chunks,
+        "chunks_wasted": sum(g.chunks_wasted for g in got_run.goal_results),
+        "goals_overlapped": got_run.goals_overlapped,
+        "frontier_dense_min": opt._FRONTIER_DENSE_MIN,
+        "aot": dict(opt.AOT_COUNTERS),
+        "single_device": side(ref_run, ref_wall, ref_f),
+        "sharded": side(got_run, got_wall, got_f),
+        "per_goal": {g.name: {
+            "steps": g.steps, "actions": g.actions_applied,
+            "wall_s": round(g.duration_s, 3),
+            "satisfied_after": g.satisfied_after,
+            "fetches": g.fetches,
+            "chunks_speculative": g.chunks_speculative,
+            "chunks_wasted": g.chunks_wasted,
+            "pipelined": g.pipelined,
+            "boundary_gap_s": round(g.boundary_gap_s, 4),
+            **({"chunks": g.chunks} if g.chunks else {}),
+        } for g in got_run.goal_results},
+        **({"fast_mode": True} if fast else {}),
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        f"MESH_{scale}.json")
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1, sort_keys=True)
+        f.write("\n")
+    rec["mesh_artifact"] = os.path.basename(path)
+    return rec
+
+
 def run_execute_rung(scale: str, max_candidates, fast: bool) -> dict:
     """--execute: drive a REAL rung proposal plan through the executor
     against the simulated fleet (SimulatedClusterAdmin — per-replica
@@ -868,15 +1052,19 @@ def _compile_ceiling_probe(constraint, options_cls, ceiling: int = 32_768) -> di
     wall THROUGH the integer ``CRUISE_TPU_COMPILE_CEILING`` gate: build the
     xl375/xl500 models, let ``_cross_ceiling_k`` parse the integer ceiling,
     mirror ``_optimize``'s width clamp, and AOT lower+compile ONE goal's
-    budget-fixpoint program at the clamped shape.  The wall the ceiling was
-    introduced for is a tunneled-TPU remote-compile phenomenon; on any
-    other backend this records that the gated, clamped shape lowers and
-    compiles — the honest CPU-side evidence that the integer knob selects
-    a compilable program (``backend`` says which side produced the record).
-    Budget-guarded: rungs are skipped, not wedged, when the bench's total
-    budget would not survive the compile."""
+    budget-fixpoint program at the clamped shape THROUGH the
+    ``CRUISE_AOT_PRELOWER`` prelower/ship path — the probe flips the flag
+    for its own calls, so each rung's executable lands in the persistent
+    artifact store and the rung records the ``prelowered`` /
+    ``shipped_bytes`` deltas (the transport-side fix the ceiling gate was
+    holding the door for; "Scale limits", docs/DESIGN_ANALYZER.md).  The
+    wall the ceiling was introduced for is a tunneled-TPU remote-compile
+    phenomenon; on any other backend this records that the gated, clamped
+    shape lowers, compiles, and ships — the honest CPU-side evidence that
+    the integer knob selects a compilable program (``backend`` says which
+    side produced the record).  Budget-guarded: rungs are skipped, not
+    wedged, when the bench's total budget would not survive the compile."""
     import jax
-    import jax.numpy as jnp
 
     from cruise_control_tpu.analyzer import candidates as cgen
     from cruise_control_tpu.analyzer import optimizer as opt
@@ -921,17 +1109,28 @@ def _compile_ceiling_probe(constraint, options_cls, ceiling: int = 32_768) -> di
                 "num_replicas": int(model.replica_valid.sum()),
                 "num_brokers": brokers,
                 "ns": [ns0, ns], "nd": [nd0, nd], "k": ns * nd}
-        fn = opt._get_budget_fixpoint_fn(gspec, (), constraint, ns, nd)
+        prev_aot = os.environ.get("CRUISE_AOT_PRELOWER")
+        os.environ["CRUISE_AOT_PRELOWER"] = "1"
+        before_aot = dict(opt.AOT_COUNTERS)
         t0 = time.monotonic()
         try:
-            compiled = fn.lower(model, options_cls.none(model),
-                                jnp.int32(8)).compile()
+            fam = opt.prelower_bucket_family(
+                model, options_cls.none(model), gspec, (), constraint, ns, nd)
             rung["compile_s"] = round(time.monotonic() - t0, 1)
-            rung["ok"] = compiled is not None
+            rung["ok"] = bool(fam)
+            rung["aot_prelowered"] = (opt.AOT_COUNTERS["prelowered"]
+                                      - before_aot["prelowered"])
+            rung["aot_shipped_bytes"] = (opt.AOT_COUNTERS["shipped_bytes"]
+                                         - before_aot["shipped_bytes"])
         except Exception as e:  # record the failure, don't kill the rung
             rung["compile_s"] = round(time.monotonic() - t0, 1)
             rung["ok"] = False
             rung["error"] = f"{type(e).__name__}: {e}"[:200]
+        finally:
+            if prev_aot is None:
+                os.environ.pop("CRUISE_AOT_PRELOWER", None)
+            else:
+                os.environ["CRUISE_AOT_PRELOWER"] = prev_aot
         probe["rungs"].append(rung)
         del model
     return probe
@@ -1820,7 +2019,25 @@ def run_sla_rung(scale: str, max_candidates, fast: bool) -> dict:
     return rec
 
 
+def _mesh_child_main() -> None:
+    """Entry for the --mesh rung's subprocess (BENCH_MESH_CHILD=1): no
+    watchdogs, no partial file — the parent's rung deadline budgets the
+    child, which prints exactly one JSON line."""
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mesh", action="store_true")
+    ap.add_argument("--rungs", default="mid")
+    args, _ = ap.parse_known_args()
+    scale = args.rungs.split(",")[0].strip()
+    max_candidates = int(os.environ.get("BENCH_MAX_CANDIDATES", "0")) or None
+    fast = bool(int(os.environ.get("BENCH_FAST", "0")))
+    rec = run_mesh_child(scale, max_candidates, fast)
+    print(json.dumps(rec), flush=True)
+
+
 def main() -> None:
+    if os.environ.get("BENCH_MESH_CHILD") == "1":
+        _mesh_child_main()
+        return
     # Rung selection: --rungs flag > BENCH_SCALE env > default small,mid.
     # The default deliberately stops at mid (~10k replicas): it is the
     # largest set that reliably clears a 600 s CPU budget, so the bare
@@ -1875,6 +2092,13 @@ def main() -> None:
                          "driven through the detect→heal pipeline against "
                          "the simulated fleet, write CHAOS_<rung>.json "
                          "(default rung: mid)")
+    ap.add_argument("--mesh", action="store_true",
+                    help="run the GSPMD parity twin rung(s) instead: solve "
+                         "the stack single-device AND replica-axis-sharded "
+                         "over an 8-device virtual CPU mesh in a subprocess "
+                         "(proposal bit-identity, equisatisfaction, live "
+                         "compaction + speculation enforced in-rung), write "
+                         "MESH_<rung>.json (default rung: mid)")
     ap.add_argument("--sla", action="store_true",
                     help="run the long-horizon soak rung(s) instead: drive "
                          "the full service loop (cruise refresh, detector "
@@ -1890,7 +2114,8 @@ def main() -> None:
         # so every heal solve's convergence rides the detector.heal trace.
         os.environ["CRUISE_FLIGHT_RECORDER"] = "1"
     default_rungs = ("mid" if (args.execute or args.warm or args.pipeline
-                               or args.chaos or args.replan or args.sla)
+                               or args.chaos or args.replan or args.sla
+                               or args.mesh)
                      else "small,mid")
     scale_sel = args.rungs or os.environ.get("BENCH_SCALE") or default_rungs
     scales = (["small", "mid", "large"] if scale_sel == "ladder"
@@ -1953,6 +2178,7 @@ def main() -> None:
                   else "chaos_time_to_heal_small" if args.chaos
                   else "replan_time_to_balanced_small" if args.replan
                   else "sla_soak_balancedness_floor_small" if args.sla
+                  else "mesh_stack_parity_small" if args.mesh
                   else "wall_clock_to_goal_satisfying_proposal_small")
         _record_rung({"metric": metric, "value": 0.0, "unit": "s",
                       "vs_baseline": 0.0, "selftest": True, "lint": lint,
@@ -1961,7 +2187,8 @@ def main() -> None:
                       **({"pipeline": True} if args.pipeline else {}),
                       **({"chaos": True} if args.chaos else {}),
                       **({"replan": True} if args.replan else {}),
-                      **({"sla": True} if args.sla else {})})
+                      **({"sla": True} if args.sla else {}),
+                      **({"mesh": True} if args.mesh else {})})
         while True:
             signal.pause()
 
@@ -1985,6 +2212,7 @@ def main() -> None:
                else run_chaos_rung(s, max_candidates, fast) if args.chaos
                else run_replan_rung(s, max_candidates, fast) if args.replan
                else run_sla_rung(s, max_candidates, fast) if args.sla
+               else run_mesh_rung(s, max_candidates, fast) if args.mesh
                else run_rung(s, max_candidates, fast))
         cancel()
         rec["backend"] = platform

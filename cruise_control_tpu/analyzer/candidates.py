@@ -33,6 +33,26 @@ from cruise_control_tpu.model.tensor_model import BrokerState, TensorClusterMode
 _NEG = -1e29  # "irrelevant" sentinel threshold (relevance uses -1e30)
 
 
+def shard_candidate_batch(cand: Candidates, mesh) -> Candidates:
+    """Partition a candidate batch's K axis over the search mesh.
+
+    Every ``Candidates`` leaf carries K as its leading dim, so one
+    ``with_sharding_constraint`` with ``P(search)`` pins the whole batch to
+    a by-candidate layout: each device owns K/n candidates end to end
+    (legitimacy mask, delta math, scoring), and GSPMD propagates the
+    partition backwards through the leg construction instead of
+    replicating the batch per chip.  Values are untouched — sharding
+    constraints change layout, never results — so the sharded solve stays
+    bit-identical to the single-device one.  No-op without a mesh (or on a
+    1-device mesh) so single-chip graphs stay byte-identical."""
+    if mesh is None or mesh.devices.size <= 1:
+        return cand
+    sharding = jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(mesh.axis_names[0]))
+    return jax.tree.map(
+        lambda x: jax.lax.with_sharding_constraint(x, sharding), cand)
+
+
 def default_num_sources(model: TensorClusterModel) -> int:
     """Top-S source replicas per step.  Wide enough that every broker can
     shed several replicas per step, but no wider: at the 50-broker rung the
@@ -352,7 +372,8 @@ def combined_move_candidates(spec: GoalSpec, model: TensorClusterModel,
                              arrays: BrokerArrays, constraint: BalancingConstraint,
                              options: OptimizationOptions, cross_sources: int,
                              num_dests: int, num_matched: int = 0,
-                             relevance=None, bands=None, active=None) -> Candidates:
+                             relevance=None, bands=None, active=None,
+                             mesh=None) -> Candidates:
     """ONE move batch combining the cross legs with the goal's matched legs
     (replica- or topic-distribution transport match, when ``num_matched`` >
     0).  Building them as one batch shares the relevance ranking, the
@@ -360,7 +381,9 @@ def combined_move_candidates(spec: GoalSpec, model: TensorClusterModel,
     separate-builders path paid each of those twice per step.  ``active``
     (the frontier mask, bool[B]) restricts sources and destinations to the
     active broker set; topic legs never see it (topic goals are not band
-    kinds, so the frontier never engages there)."""
+    kinds, so the frontier never engages there).  ``mesh`` partitions the
+    finished batch's K axis over the search mesh
+    (``shard_candidate_batch``)."""
     if relevance is None:
         relevance = kernels.source_replica_relevance(spec, model, arrays,
                                                      constraint, bands=bands)
@@ -381,7 +404,8 @@ def combined_move_candidates(spec: GoalSpec, model: TensorClusterModel,
         replica = jnp.concatenate([replica, r2])
         dest = jnp.concatenate([dest, d2])
         ok = jnp.concatenate([ok, ok2])
-    return _finish_move_legs(model, arrays, options, replica, dest, ok)
+    return shard_candidate_batch(
+        _finish_move_legs(model, arrays, options, replica, dest, ok), mesh)
 
 
 def default_num_matched(model: TensorClusterModel, num_sources: int) -> int:
@@ -513,7 +537,8 @@ def default_num_swap_partners(model: TensorClusterModel) -> int:
 def swap_candidates(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArrays,
                     constraint: BalancingConstraint, options: OptimizationOptions,
                     num_out: int, num_in: int,
-                    relevance=None, bands=None, active=None) -> Candidates:
+                    relevance=None, bands=None, active=None,
+                    mesh=None) -> Candidates:
     """K = S_out·S_in inter-broker replica-SWAP candidates.
 
     The reference's pairwise swap search walks an over-utilized broker's
@@ -549,7 +574,8 @@ def swap_candidates(spec: GoalSpec, model: TensorClusterModel, arrays: BrokerArr
     src_ok = jnp.repeat(out_vals > _NEG, num_in)
 
     valid = src_ok & _legit_swap_mask(model, arrays, options, r1, r2)
-    return make_swap_candidates(model, r1, r2, valid)
+    return shard_candidate_batch(
+        make_swap_candidates(model, r1, r2, valid), mesh)
 
 
 def _legit_swap_mask(model: TensorClusterModel, arrays: BrokerArrays,

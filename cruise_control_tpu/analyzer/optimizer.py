@@ -110,6 +110,20 @@ def _flight_recorder() -> bool:
     return os.environ.get("CRUISE_FLIGHT_RECORDER", "").strip() == "1"
 
 
+def _aot_prelower() -> bool:
+    """CRUISE_AOT_PRELOWER=1 turns on ahead-of-time lowering of the bucket
+    family: the chunk driver AOT-compiles each (goal, bucket, mesh) shape
+    via ``fn.lower(...).compile()`` before dispatching it and ships the
+    serialized executable through the persistent artifact store
+    (``common/compile_cache.py``), so tunneled transport moves a cached
+    artifact once instead of re-serializing every fresh build — the actual
+    root cause of the 375k-candidate ceiling (PR 9 probe).  Like
+    ``_repair_oracle`` the flag is read by every _get_* cache constructor
+    so it is part of the python cache key — flipping it mid-process never
+    reuses a stale executable."""
+    return os.environ.get("CRUISE_AOT_PRELOWER", "").strip() == "1"
+
+
 #: Canonical order of the candidate-kind segments ``_goal_step`` concatenates;
 #: ``FLIGHT_KIND`` rows index into this tuple (-1 = no action kept).
 FLIGHT_KINDS = ("move", "leadership", "intra_move", "swap", "intra_swap")
@@ -1070,7 +1084,7 @@ def _goal_step(model: TensorClusterModel, options: OptimizationOptions,
         batches.append(cgen.combined_move_candidates(
             spec, model, arrays, constraint, options, cross_ns, num_dests,
             num_matched=num_matched, relevance=relevance, bands=bands,
-            active=active))
+            active=active, mesh=mesh))
         kind_ids.append(FLIGHT_KINDS.index("move"))
     if spec.uses_leadership:
         batches.append(cgen.leadership_candidates(spec, model, arrays, constraint,
@@ -1092,7 +1106,7 @@ def _goal_step(model: TensorClusterModel, options: OptimizationOptions,
     if spec.uses_swaps:
         batches.append(cgen.swap_candidates(
             spec, model, arrays, constraint, options, sw_s, sw_p,
-            relevance=relevance, bands=bands, active=active))
+            relevance=relevance, bands=bands, active=active, mesh=mesh))
         kind_ids.append(FLIGHT_KINDS.index("swap"))
     if spec.uses_intra_swaps:
         batches.append(cgen.intra_swap_candidates(
@@ -1144,6 +1158,23 @@ def _goal_step(model: TensorClusterModel, options: OptimizationOptions,
         # be eligible (the candidate builders already bias against them;
         # this makes it absolute).
         eligible = eligible & active[cand.src] & active[cand.dest]
+    if (mesh is not None and frontier is not None
+            and frontier.shard_active is not None):
+        # Per-shard frontier mask: each candidate endpoint's compact-slot
+        # liveness ANDed into eligibility.  Semantically subsumed by the
+        # active[] clause above (an inactive broker has no live compact
+        # slot), so proposals stay bit-identical — but it hands GSPMD a
+        # genuinely P(search)-partitioned compact-axis operand on the
+        # eligibility path, anchoring the by-candidate partition of the
+        # compacted selection instead of letting the bucket replicate.
+        sharding = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(mesh.axis_names[0]))
+        shard_live = jax.lax.with_sharding_constraint(
+            frontier.shard_active, sharding)
+        bc = shard_live.shape[0]
+        slot_src = jnp.clip(frontier.compact_of_full[cand.src], 0, bc - 1)
+        slot_dest = jnp.clip(frontier.compact_of_full[cand.dest], 0, bc - 1)
+        eligible = eligible & shard_live[slot_src] & shard_live[slot_dest]
     all_specs = (spec,) + prev_specs
     room_dest, slack_src = _channel_budgets(all_specs, model, arrays, constraint,
                                             sides=(inv.upper_min, inv.lower_max))
@@ -1244,8 +1275,9 @@ def _get_step_fn(spec: GoalSpec, prev_specs: Tuple[GoalSpec, ...],
     # The traced step derives rack-goal batch widths from the compile
     # ceiling (_goal_num_sources), so the ceiling is part of the program.
     ceiling = _cross_ceiling_k()
+    aot = _aot_prelower()
     key = (spec, prev_specs, constraint, num_sources, num_dests, mesh, donate,
-           oracle, ceiling)
+           oracle, ceiling, aot)
     fn = _step_cache.get(key)
     if fn is None:
         fn = jax.jit(partial(_goal_step, spec=spec, prev_specs=prev_specs,
@@ -1328,8 +1360,9 @@ def _get_fixpoint_fn(spec: GoalSpec, prev_specs: Tuple[GoalSpec, ...],
                      donate: bool = False):
     oracle = _repair_oracle()
     ceiling = _cross_ceiling_k()
+    aot = _aot_prelower()
     key = (spec, prev_specs, constraint, num_sources, num_dests, max_steps,
-           mesh, donate, oracle, ceiling)
+           mesh, donate, oracle, ceiling, aot)
     fn = _fixpoint_cache.get(key)
     if fn is None:
         fn = jax.jit(partial(_goal_fixpoint, spec=spec, prev_specs=prev_specs,
@@ -1386,18 +1419,36 @@ def _frontier_widths(bucket: int, ns: int, nd: int, lanes: int = 1):
     return cns, cnd
 
 
-def _build_frontier(active_np: np.ndarray, bucket: int) -> FrontierInvariants:
+def _build_frontier(active_np: np.ndarray, bucket: int,
+                    mesh=None) -> FrontierInvariants:
     """Host-side index maps from a fetched bool[B] mask (numpy: the mask was
     just device_get for the bucket decision; building the maps here costs
-    nothing on device and keeps the compact ids dense and stable)."""
+    nothing on device and keeps the compact ids dense and stable).
+
+    Under a multi-device ``mesh`` the invariants additionally carry the
+    per-shard frontier mask ``shard_active`` (bool[bucket] compact-slot
+    liveness) device_put with ``P(search)`` — each device owns its slice of
+    the bucket, giving every GSPMD chunk a genuinely partitioned
+    compact-axis operand (see FrontierInvariants).  The pow2 bucket ladder
+    starts at ``_FRONTIER_DENSE_MIN`` so the bucket always divides evenly
+    over power-of-two meshes; a non-dividing mesh degrades to a replicated
+    mask rather than ragged shards."""
     idx = np.flatnonzero(active_np).astype(np.int32)
     full_of_compact = np.full((bucket,), -1, np.int32)
     full_of_compact[:idx.size] = idx
     compact_of_full = np.full((active_np.shape[0],), -1, np.int32)
     compact_of_full[idx] = np.arange(idx.size, dtype=np.int32)
+    shard_active = None
+    if mesh is not None and mesh.devices.size > 1:
+        spec = (jax.sharding.PartitionSpec(mesh.axis_names[0])
+                if bucket % mesh.devices.size == 0
+                else jax.sharding.PartitionSpec())
+        shard_active = jax.device_put(
+            full_of_compact >= 0, jax.sharding.NamedSharding(mesh, spec))
     return FrontierInvariants(active=jnp.asarray(active_np),
                               compact_of_full=jnp.asarray(compact_of_full),
-                              full_of_compact=jnp.asarray(full_of_compact))
+                              full_of_compact=jnp.asarray(full_of_compact),
+                              shard_active=shard_active)
 
 
 # Dispatch/fetch accounting of the async chunk drivers (this module's
@@ -1415,27 +1466,52 @@ FETCH_COUNTERS = {"device_fetches": 0, "chunks_dispatched": 0,
                   # fetches (0 with CRUISE_FLIGHT_RECORDER off) — lets the
                   # dispatch audit attribute recorder traffic separately
                   # while proving the fetch COUNT is unchanged.
-                  "flight_bytes": 0}
+                  "flight_bytes": 0,
+                  # Total bytes every boundary fetch moved over the search
+                  # axis (packed stats + active mask + flight buffer) —
+                  # the per-shard dispatch-economy denominator.
+                  "fetch_bytes": 0}
 
-_gate_fn = None
-_cross_gate_fn = None
+_gate_cache: Dict[tuple, object] = {}
 
 
-def _get_gate_fn():
+def _replicated_on(mesh):
+    """A closure pinning a scalar result to the mesh's replicated layout —
+    this is what turns the tiny gate programs into GSPMD dispatches: with a
+    mesh-layout operand/constraint XLA partitions the (trivial) computation
+    over the same device set as the chunk programs instead of compiling a
+    single-chip executable whose output would have to be re-laid-out before
+    feeding the next sharded chunk's budget argument."""
+    sharding = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+    return lambda x: jax.lax.with_sharding_constraint(x, sharding)
+
+
+def _get_gate_fn(mesh=None):
     """Jitted ``(packed, budget) -> packed[PACKED_CAPPED] * budget`` — the
     on-device budget gate of speculative dispatch.  The follow-up chunk's
     step budget is the predecessor's capped flag times the host's optimistic
     chunk length, computed WITHOUT fetching the flag: if the predecessor
     converged the product is 0 and the follow-up is a no-op by construction.
-    One tiny executable shared by every goal (packed layout is uniform)."""
-    global _gate_fn
-    if _gate_fn is None:
-        _gate_fn = jax.jit(
-            lambda packed, budget: packed[PACKED_CAPPED] * budget)
-    return _gate_fn
+    One tiny executable per mesh shape shared by every goal (packed layout
+    is uniform); under a mesh the gate compiles as a GSPMD program whose
+    replicated output feeds the sharded chunk directly (no host round-trip,
+    no cross-program relayout)."""
+    aot = _aot_prelower()
+    key = ("budget", mesh, aot)
+    fn = _gate_cache.get(key)
+    if fn is None:
+        if mesh is not None and mesh.devices.size > 1:
+            rep = _replicated_on(mesh)
+            fn = jax.jit(
+                lambda packed, budget: rep(packed[PACKED_CAPPED] * budget))
+        else:
+            fn = jax.jit(
+                lambda packed, budget: packed[PACKED_CAPPED] * budget)
+        _gate_cache[key] = fn
+    return fn
 
 
-def _get_cross_gate_fn():
+def _get_cross_gate_fn(mesh=None):
     """Jitted cross-GOAL budget gate: the next goal's speculative opening
     chunk may only run when the current goal's chunk proved the goal DONE
     (satisfied, not capped, no offline replicas left — the same exit test
@@ -1443,17 +1519,26 @@ def _get_cross_gate_fn():
     since the frontier sweep lies inside the next goal's predicted seed
     frontier (``PACKED_CONFLICT`` == 0).  Any other outcome collapses the
     opener to a zero-step no-op, bit-identical to never dispatching it —
-    this is the PR-5 speculation gate extended across the goal boundary."""
-    global _cross_gate_fn
-    if _cross_gate_fn is None:
-        _cross_gate_fn = jax.jit(
-            lambda packed, budget: jnp.where(
+    this is the PR-5 speculation gate extended across the goal boundary.
+    Like ``_get_gate_fn`` the mesh variant dispatches under GSPMD with a
+    replicated output layout."""
+    aot = _aot_prelower()
+    key = ("cross", mesh, aot)
+    fn = _gate_cache.get(key)
+    if fn is None:
+        def gate(packed, budget):
+            out = jnp.where(
                 (packed[PACKED_AFTER] == 1)
                 & (packed[PACKED_CAPPED] == 0)
                 & (packed[PACKED_ANY_OFFLINE] == 0)
                 & (packed[PACKED_CONFLICT] == 0),
-                budget, 0))
-    return _cross_gate_fn
+                budget, 0)
+            if mesh is not None and mesh.devices.size > 1:
+                out = _replicated_on(mesh)(out)
+            return out
+        fn = jax.jit(gate)
+        _gate_cache[key] = fn
+    return fn
 
 
 def _flight_step_dicts(rows, start_step: int, chunk_index: int) -> List[dict]:
@@ -1623,8 +1708,9 @@ def _get_budget_fixpoint_fn(spec: GoalSpec, prev_specs: Tuple[GoalSpec, ...],
                             flight_capacity: int = 0):
     oracle = _repair_oracle()
     ceiling = _cross_ceiling_k()
+    aot = _aot_prelower()
     key = (spec, prev_specs, constraint, num_sources, num_dests, mesh, donate,
-           oracle, flight_capacity, ceiling)
+           oracle, flight_capacity, ceiling, aot)
     fn = _budget_cache.get(key)
     if fn is None:
         fn = jax.jit(partial(_goal_fixpoint_budget, spec=spec,
@@ -1635,6 +1721,134 @@ def _get_budget_fixpoint_fn(spec: GoalSpec, prev_specs: Tuple[GoalSpec, ...],
                      donate_argnums=(0,) if donate else ())
         _budget_cache[key] = fn
     return fn
+
+
+# ---------------------------------------------------------------------------
+# AOT executable prelowering + shipping (CRUISE_AOT_PRELOWER)
+# ---------------------------------------------------------------------------
+# The 375k-candidate ceiling is transport-side (PR 9 probe): a tunneled
+# runtime re-serializes every FRESHLY BUILT executable over the control
+# channel, and the xl bucket family's executables are big enough that the
+# per-compile serialization dominates — not the compile itself.  The fix is
+# to lower and compile each (goal, bucket, mesh) shape AHEAD of dispatch
+# (``jax.jit(...).lower(args).compile()`` — ``lower`` records the exact
+# input shardings without executing) and persist the serialized artifact
+# through ``common/compile_cache.py`` once, so transport ships a cached
+# artifact instead of re-serializing per build.  The registries below are
+# process-global like the jit caches; ``conftest.py`` clears them between
+# test modules.
+
+AOT_COUNTERS = {"prelowered": 0, "shipped_bytes": 0,
+                "aot_dispatches": 0, "aot_fallbacks": 0}
+
+#: (kind,) + builder key + arg-shape signature -> jax.stages.Compiled
+_aot_registry: Dict[tuple, object] = {}
+#: same key -> {"collectives": int} parsed from the compiled HLO
+_aot_hlo: Dict[tuple, dict] = {}
+
+#: HLO op substrings counted as cross-device collectives (the per-shard
+#: dispatch-economy column in tools/dispatch_report.py).
+_COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",
+                   "collective-permute", "all-to-all")
+
+
+def _collective_count(hlo_text: str) -> int:
+    return sum(hlo_text.count(op) for op in _COLLECTIVE_OPS)
+
+
+def _aot_signature(args) -> tuple:
+    return tuple((tuple(leaf.shape), str(leaf.dtype))
+                 for leaf in jax.tree_util.tree_leaves(args)
+                 if hasattr(leaf, "shape"))
+
+
+def aot_prelower_fn(fn, kind: str, key: tuple, args):
+    """AOT-compile ``fn`` at ``args``'s exact shapes/shardings and ship the
+    serialized executable through the persistent artifact store.  Returns
+    the ``jax.stages.Compiled`` (registry-cached per arg signature, so one
+    executable per (goal, bucket, mesh) shape).  ``lower`` accepts the
+    concrete args without executing them and records their committed
+    shardings — prelowering with the live model gives a Compiled whose
+    input layout matches every later dispatch of the same shape."""
+    sig = _aot_signature(args)
+    akey = (kind,) + tuple(key) + (sig,)
+    compiled = _aot_registry.get(akey)
+    if compiled is not None:
+        return compiled, akey
+    compiled = fn.lower(*args).compile()
+    _aot_registry[akey] = compiled
+    AOT_COUNTERS["prelowered"] += 1
+    try:
+        hlo = compiled.as_text()
+    except Exception:  # backend without HLO text — stats stay unknown
+        hlo = ""
+    _aot_hlo[akey] = {"collectives": _collective_count(hlo)}
+    token = compile_cache.program_token("aot-" + kind, tuple(key), sig)
+    AOT_COUNTERS["shipped_bytes"] += compile_cache.ship_executable(
+        token, compiled)
+    return compiled, akey
+
+
+def _call_chunk(fn, kind: str, key: tuple, args):
+    """Dispatch one chunk program: through its AOT-prelowered executable
+    when ``CRUISE_AOT_PRELOWER`` is on (Compiled objects skip the jit
+    call-cache machinery entirely — no re-serialization on a tunneled
+    runtime), else the jit fn.  A Compiled errors (rather than resharding)
+    on a committed-array layout mismatch, so any dispatch the prelowered
+    executable cannot serve falls back to the jit fn — correctness never
+    depends on the AOT path.  Returns ``(outputs, akey)``; ``akey`` (None
+    on the jit path) indexes ``_aot_hlo`` for per-shard report columns."""
+    if not _aot_prelower():
+        return fn(*args), None
+    try:
+        compiled, akey = aot_prelower_fn(fn, kind, key, args)
+        out = compiled(*args)
+        AOT_COUNTERS["aot_dispatches"] += 1
+        return out, akey
+    except Exception:
+        AOT_COUNTERS["aot_fallbacks"] += 1
+        return fn(*args), None
+
+
+def prelower_bucket_family(model, options, spec: GoalSpec,
+                           prev_specs: Tuple[GoalSpec, ...],
+                           constraint: BalancingConstraint, ns: int, nd: int,
+                           buckets=(None,), mesh=None, donate: bool = False,
+                           flight_capacity: int = 0,
+                           pipelined: bool = False):
+    """AOT-lower and ship ``spec``'s chunk-program family AHEAD of a solve:
+    one executable per frontier bucket shape (``None`` = dense) at the
+    given candidate widths and mesh.  The registry keys match what the
+    chunk driver's dispatches produce, so a later ``frontier_fixpoint`` run
+    over the same shapes dispatches straight into the prelowered
+    executables — no build, no per-compile transport serialization mid
+    solve.  Frontier values don't matter to the trace (only shapes do), so
+    an all-inactive mask stands in for every future frontier of the same
+    bucket.  No-op (empty list) unless ``CRUISE_AOT_PRELOWER=1``; returns
+    one record per bucket: {bucket, ns, nd, collectives}."""
+    if not _aot_prelower():
+        return []
+    B = model.num_brokers
+    lanes = int(mesh.devices.size) if mesh is not None else 1
+    bud = jnp.int32(0)
+    out = []
+    for bucket in buckets:
+        cns, cnd = (ns, nd) if bucket is None else _frontier_widths(
+            bucket, ns, nd, lanes)
+        fn = _get_budget_fixpoint_fn(spec, prev_specs, constraint, cns, cnd,
+                                     mesh=mesh, donate=donate,
+                                     flight_capacity=flight_capacity)
+        fr = (None if bucket is None
+              else _build_frontier(np.zeros(B, bool), bucket, mesh))
+        args = (model, options, bud, fr)
+        if pipelined:
+            args = args + (jnp.zeros((B,), bool), jnp.zeros((B,), bool))
+        key = (spec, prev_specs, constraint, cns, cnd, mesh, donate,
+               flight_capacity)
+        _, akey = aot_prelower_fn(fn, "budget", key, args)
+        out.append({"bucket": bucket, "ns": cns, "nd": cnd,
+                    "collectives": _aot_hlo.get(akey, {}).get("collectives")})
+    return out
 
 
 def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
@@ -1769,7 +1983,7 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
         nb = _frontier_bucket(int(seed_np.sum()), B)
         if nb is not None:
             bucket = nb
-            fr = _build_frontier(seed_np, nb)
+            fr = _build_frontier(seed_np, nb, mesh)
             seeded = int(seed_np.sum())
     # Inter-goal pipelining state.  ``pipelined`` switches every dispatch
     # to the 6-arg trace (touched mask + next-goal seed mask ride through
@@ -1803,7 +2017,7 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
                 nb = _frontier_bucket(int(nseed.sum()), B)
                 if nb is not None:
                     opener_bucket = nb
-                    opener_fr = _build_frontier(nseed, nb)
+                    opener_fr = _build_frontier(nseed, nb, mesh)
                     opener_seeded = int(nseed.sum())
                     # Conflict accounting only protects COMPACTED openers;
                     # a dense opener sees every broker and is always valid,
@@ -1844,28 +2058,37 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
                                      mesh=mesh, donate=donate,
                                      flight_capacity=fc)
         size0 = fn._cache_size() if hasattr(fn, "_cache_size") else None
+        aot0 = len(_aot_registry)
         bud = jnp.int32(budget) if isinstance(budget, int) else budget
+        fn_key = (sp, pv, constraint, cns, cnd, mesh, donate, fc)
         if pipelined:
             # 6-arg trace: the opener's conflict slot is meaningless for
             # the NEXT driver's own next goal, so cross dispatches carry an
             # all-zeros mask (their conflict slot is never consulted).
             mask = next_mask_d if spec_d is None else jnp.zeros((B,), bool)
+            outs, akey = _call_chunk(
+                fn, "budget", fn_key, (model, options, bud, fr, touched_d,
+                                       mask))
             if fc:
-                model, packed_d, active_d, touched_d, flight_d = fn(
-                    model, options, bud, fr, touched_d, mask)
+                model, packed_d, active_d, touched_d, flight_d = outs
             else:
-                model, packed_d, active_d, touched_d = fn(
-                    model, options, bud, fr, touched_d, mask)
+                model, packed_d, active_d, touched_d = outs
                 flight_d = None
-        elif fc:
-            model, packed_d, active_d, flight_d = fn(model, options, bud, fr)
         else:
-            model, packed_d, active_d = fn(model, options, bud, fr)
-            flight_d = None
+            outs, akey = _call_chunk(fn, "budget", fn_key,
+                                     (model, options, bud, fr))
+            if fc:
+                model, packed_d, active_d, flight_d = outs
+            else:
+                model, packed_d, active_d = outs
+                flight_d = None
         # A chunk that built (or deserialized) its executable this process
         # carries that one-off wall in wall_s — flag it so the wall-slope
-        # flatness metric can exclude it (tools/tail_report.py).
-        chunk_fresh = size0 is not None and fn._cache_size() > size0
+        # flatness metric can exclude it (tools/tail_report.py).  An AOT
+        # prelower this dispatch counts the same way (the build just moved
+        # ahead of the call).
+        chunk_fresh = ((size0 is not None and fn._cache_size() > size0)
+                       or len(_aot_registry) > aot0)
         if chunk_fresh:
             # New trace for this (goal, bucket shape) — refine "fresh" the
             # same way the stack path does: a persistent-cache marker means
@@ -1891,7 +2114,9 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
                 "bucket": bucket, "fr": fr, "ns": cns, "nd": cnd,
                 "blen": blen, "fresh": chunk_fresh,
                 "speculative": speculative, "confirm": confirm,
-                "cross": cross, "t_dispatch": now}
+                "cross": cross, "t_dispatch": now,
+                "collectives": (_aot_hlo.get(akey, {}).get("collectives")
+                                if akey is not None else None)}
 
     while steps_done < max_steps:
         if pending is not None:
@@ -1916,7 +2141,7 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
             nxt = min(chunk * 2, chunk_steps) if grow else chunk
             nxt = min(nxt, max_steps - steps_done - cur["blen"])
             if nxt > 0:
-                gated = _get_gate_fn()(cur["packed"], jnp.int32(nxt))
+                gated = _get_gate_fn(mesh)(cur["packed"], jnp.int32(nxt))
                 pending = _dispatch(cur["bucket"], cur["fr"], gated, nxt,
                                     True)
         cross_rec: Optional[dict] = None
@@ -1933,8 +2158,8 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
             # compacted convergence still needs its dense confirm — and
             # never off an adopted prelaunch, whose conflict slot was
             # computed against the PREVIOUS driver's mask.
-            gated = _get_cross_gate_fn()(cur["packed"],
-                                         jnp.int32(opener_blen))
+            gated = _get_cross_gate_fn(mesh)(cur["packed"],
+                                             jnp.int32(opener_blen))
             cross_rec = _dispatch(opener_bucket, opener_fr, gated,
                                   opener_blen, False,
                                   spec_d=next_goal.spec,
@@ -1950,6 +2175,10 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
         if cur["flight"] is not None:
             targets.append(cur["flight"])
         fetched = list(jax.device_get(tuple(targets)))
+        # Bytes moved over the boundary (per-shard dispatch economy): the
+        # exact host-side size of everything this single fetch transferred.
+        fetch_bytes = sum(int(np.asarray(x).nbytes) for x in fetched)
+        FETCH_COUNTERS["fetch_bytes"] += fetch_bytes
         packed_np = fetched.pop(0)
         active_np = fetched.pop(0) if use_frontier else None
         flight_np = fetched.pop(0) if cur["flight"] is not None else None
@@ -1986,7 +2215,9 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
                "ns": cur["ns"], "nd": cur["nd"], "repair_steps": rep,
                "bisect_depth": dep, "lanes_live": lan,
                "fresh_compile": cur["fresh"],
-               "speculative": cur["speculative"]}
+               "speculative": cur["speculative"],
+               "fetch_bytes": fetch_bytes,
+               "collectives": cur.get("collectives")}
         chunks.append(rec)
         if flight_np is not None:
             ci = len(flight_chunks)
@@ -2067,7 +2298,7 @@ def frontier_fixpoint(model: TensorClusterModel, options: OptimizationOptions,
             if not off:
                 nb = _frontier_bucket(na, B)
                 if nb is not None:
-                    fr = _build_frontier(np.asarray(active_np), nb)
+                    fr = _build_frontier(np.asarray(active_np), nb, mesh)
                     bucket = nb
     info = {"chunks": chunks, "buckets": sorted(buckets),
             "fresh_compile": fresh, "steps": steps_done,
@@ -2127,7 +2358,8 @@ _sweep_cache: Dict[tuple, object] = {}
 
 def _get_sweep_fn(specs: Tuple[GoalSpec, ...],
                   constraint: BalancingConstraint):
-    key = (specs, constraint)
+    aot = _aot_prelower()
+    key = (specs, constraint, aot)
     fn = _sweep_cache.get(key)
     if fn is None:
         fn = jax.jit(partial(_stack_satisfied, specs=specs,
@@ -2138,7 +2370,8 @@ def _get_sweep_fn(specs: Tuple[GoalSpec, ...],
 
 def _get_frontier_sweep_fn(specs: Tuple[GoalSpec, ...],
                            constraint: BalancingConstraint):
-    key = (specs, constraint, "fronts")
+    aot = _aot_prelower()
+    key = (specs, constraint, "fronts", aot)
     fn = _sweep_cache.get(key)
     if fn is None:
         fn = jax.jit(partial(_stack_frontiers, specs=specs,
@@ -2158,7 +2391,8 @@ _placement_score_cache: Dict[tuple, object] = {}
 
 def _get_placement_score_fn(specs: Tuple[GoalSpec, ...],
                             constraint: BalancingConstraint, batch: int):
-    key = (specs, constraint, batch)
+    aot = _aot_prelower()
+    key = (specs, constraint, batch, aot)
     fn = _placement_score_cache.get(key)
     if fn is None:
         def run(before, after, masks):
@@ -2323,10 +2557,15 @@ def _push_repair_sensors(goal_name: str, repair_steps: int,
 
 
 def _push_dispatch_sensors(goal_name: str, fetches: int,
-                           chunks_speculative: int, chunks_wasted: int) -> None:
+                           chunks_speculative: int, chunks_wasted: int,
+                           fetch_bytes: int = 0,
+                           collectives: int = 0) -> None:
     """Async-orchestration counters into the sensor registry: how often the
-    chunk driver blocked on the device, and how much speculative dispatch
-    bought (launched) and burned (gated to zero)."""
+    chunk driver blocked on the device, how much speculative dispatch
+    bought (launched) and burned (gated to zero), and the per-shard
+    dispatch economy — bytes each boundary fetch moved over the search
+    axis and cross-device collectives in the dispatched programs' HLO
+    (0 on a single chip or when no AOT-lowered text is available)."""
     labels = {"goal": goal_name}
     SENSORS.counter(
         "GoalOptimizer.device-fetches", labels=labels,
@@ -2340,6 +2579,34 @@ def _push_dispatch_sensors(goal_name: str, fetches: int,
         "GoalOptimizer.chunks-wasted", labels=labels,
         help="Speculative chunks whose on-device budget gate zeroed them",
     ).inc(chunks_wasted)
+    SENSORS.counter(
+        "GoalOptimizer.boundary-fetch-bytes", labels=labels,
+        help="Bytes moved hostward by chunk-boundary fetches",
+    ).inc(fetch_bytes)
+    SENSORS.counter(
+        "GoalOptimizer.mesh-collective-ops", labels=labels,
+        help="Cross-device collectives in dispatched chunk HLO (AOT runs)",
+    ).inc(collectives)
+
+
+def _push_aot_sensors() -> None:
+    """AOT prelower/shipping accounting (CRUISE_AOT_PRELOWER=1 runs):
+    process totals from ``AOT_COUNTERS`` — how many (goal, bucket, mesh)
+    shapes were lowered ahead of dispatch, and how many serialized
+    executable bytes the persistent store shipped (the transport-side
+    traffic the 375k ceiling was made of)."""
+    SENSORS.gauge(
+        "GoalOptimizer.aot-prelowered",
+        help="Chunk executables AOT-lowered ahead of dispatch",
+    ).set(AOT_COUNTERS["prelowered"])
+    SENSORS.gauge(
+        "GoalOptimizer.executables-shipped-bytes",
+        help="Serialized executable bytes shipped to the artifact store",
+    ).set(AOT_COUNTERS["shipped_bytes"])
+    SENSORS.gauge(
+        "GoalOptimizer.aot-dispatches",
+        help="Chunk dispatches served by a prelowered executable",
+    ).set(AOT_COUNTERS["aot_dispatches"])
 
 
 def _push_flight_sensors(goal_name: str, flight: dict) -> None:
@@ -2436,8 +2703,9 @@ def _get_stack_fn(specs: Tuple[GoalSpec, ...], constraint: BalancingConstraint,
                   flight_capacity: int = 0):
     oracle = _repair_oracle()
     ceiling = _cross_ceiling_k()
+    aot = _aot_prelower()
     key = (specs, constraint, num_sources, num_dests, max_steps, mesh,
-           prev_specs, donate, oracle, flight_capacity, ceiling)
+           prev_specs, donate, oracle, flight_capacity, ceiling, aot)
     fn = _stack_cache.get(key)
     if fn is None:
         fn = jax.jit(partial(_stack_fixpoint, specs=specs, constraint=constraint,
@@ -3089,11 +3357,15 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
                                          info.get("repair_steps", 0),
                                          info.get("bisect_depth", 0),
                                          info.get("lanes_live", 0))
-                    _push_dispatch_sensors(spec.name,
-                                           info.get("fetches", 0),
-                                           info.get("chunks_speculative",
-                                                    0),
-                                           info.get("chunks_wasted", 0))
+                    _push_dispatch_sensors(
+                        spec.name,
+                        info.get("fetches", 0),
+                        info.get("chunks_speculative", 0),
+                        info.get("chunks_wasted", 0),
+                        fetch_bytes=sum(c.get("fetch_bytes", 0)
+                                        for c in info["chunks"]),
+                        collectives=sum(c.get("collectives") or 0
+                                        for c in info["chunks"]))
                     if info.get("flight") is not None:
                         _push_flight_sensors(spec.name, info["flight"])
                     if spec.is_hard and not info["satisfied_after"] \
@@ -3176,11 +3448,15 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
                                          info.get("repair_steps", 0),
                                          info.get("bisect_depth", 0),
                                          info.get("lanes_live", 0))
-                    _push_dispatch_sensors(spec.name,
-                                           info.get("fetches", 0),
-                                           info.get("chunks_speculative",
-                                                    0),
-                                           info.get("chunks_wasted", 0))
+                    _push_dispatch_sensors(
+                        spec.name,
+                        info.get("fetches", 0),
+                        info.get("chunks_speculative", 0),
+                        info.get("chunks_wasted", 0),
+                        fetch_bytes=sum(c.get("fetch_bytes", 0)
+                                        for c in info["chunks"]),
+                        collectives=sum(c.get("collectives") or 0
+                                        for c in info["chunks"]))
                     if info.get("flight") is not None:
                         _push_flight_sensors(spec.name, info["flight"])
                     if spec.is_hard and not info["satisfied_after"] \
@@ -3387,6 +3663,8 @@ def _optimize(model: TensorClusterModel, goal_names: Sequence[str],
     seed_size = int(seed_mask.sum()) if (warm and seed_mask is not None) else 0
     if warm:
         _push_warm_sensors(seed_size, goals_skipped)
+    if _aot_prelower():
+        _push_aot_sensors()
     return OptimizerRun(model=model, goal_results=results, stats_before=stats_before,
                         stats_after=compute_stats_jit(model), num_candidates_scored=scored,
                         provision_response=provision,

@@ -105,6 +105,14 @@ class FrontierInvariants:
     active: Array           # bool[B] — full-axis membership mask
     compact_of_full: Array  # i32[B] — compact id per broker, -1 when inactive
     full_of_compact: Array  # i32[Bc] — full broker id per compact slot, -1 pad
+    # Per-shard frontier mask: slot liveness over the compacted axis
+    # (``full_of_compact >= 0``).  Only materialized under a search mesh,
+    # where it is device_put with ``P(SEARCH_AXIS)`` so every GSPMD program
+    # consuming the frontier owns a genuinely partitioned compact-axis
+    # operand (each shard holds its own slice of the bucket) instead of a
+    # replicated one.  ``None`` on the single-device path keeps those
+    # graphs byte-identical to the pre-mesh builds.
+    shard_active: Optional[Array] = None  # bool[Bc] — compact slot liveness
 
 
 # ---------------------------------------------------------------------------

@@ -152,3 +152,88 @@ def mark(token: str) -> None:
             pass
     except OSError as e:  # marker loss only costs a pessimistic report
         _LOG.warning("could not write compile marker %s: %s", path, e)
+
+
+# ---------------------------------------------------------------------------
+# AOT executable shipping (CRUISE_AOT_PRELOWER)
+# ---------------------------------------------------------------------------
+# The artifact store for ahead-of-time-compiled executables.  This is a
+# DIFFERENT code path from jax's own ``jax_compilation_cache_dir``
+# machinery on purpose: this jaxlib segfaults inside
+# ``compilation_cache.put_executable_and_time`` when serializing the large
+# goal-stack executables (tests/conftest.py), while
+# ``jax.experimental.serialize_executable.serialize`` on an already-built
+# ``jax.stages.Compiled`` does not go through that path.  Artifacts land in
+# an ``aot/`` subdir of the persistent cache dir (or the default XDG dir
+# when the jax cache is not enabled — shipping works standalone), one
+# ``<token>.aotx`` per program, written atomically.
+
+SHIP_COUNTERS = {"shipped": 0, "shipped_bytes": 0, "hits": 0, "failed": 0}
+
+
+def shipping_dir() -> Optional[str]:
+    """The AOT artifact directory (created on demand), or None when it
+    cannot be created.  Uses the enabled persistent-cache dir when one is
+    active, else resolves the default location WITHOUT touching jax's own
+    compilation-cache config (see the segfault note above)."""
+    base = _enabled_dir or resolve_cache_dir()
+    if base is None:
+        return None
+    path = os.path.join(os.path.abspath(base), "aot")
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError as e:
+        _LOG.warning("could not create AOT shipping dir %s: %s", path, e)
+        return None
+    return path
+
+
+def _artifact_file(token: str) -> Optional[str]:
+    d = shipping_dir()
+    return None if d is None else os.path.join(d, token + ".aotx")
+
+
+def ship_executable(token: str, compiled) -> int:
+    """Serialize an AOT-compiled executable into the artifact store.
+
+    Returns the bytes written (the ``executables-shipped-bytes`` sensor's
+    unit); 0 when the artifact already exists (shipped once, by design),
+    when serialization is unavailable on this backend, or when the store
+    cannot be written — shipping is an optimization, never a correctness
+    gate."""
+    path = _artifact_file(token)
+    if path is None:
+        return 0
+    if os.path.exists(path):
+        SHIP_COUNTERS["hits"] += 1
+        return 0
+    try:
+        from jax.experimental import serialize_executable as se
+        payload = se.serialize(compiled)
+        blob = payload[0] if isinstance(payload, tuple) else payload
+        data = bytes(blob)
+    except Exception as e:  # noqa: BLE001 — backend/version specific
+        SHIP_COUNTERS["failed"] += 1
+        _LOG.warning("could not serialize AOT executable %s: %s", token, e)
+        return 0
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except OSError as e:
+        SHIP_COUNTERS["failed"] += 1
+        _LOG.warning("could not ship AOT executable %s: %s", path, e)
+        return 0
+    SHIP_COUNTERS["shipped"] += 1
+    SHIP_COUNTERS["shipped_bytes"] += len(data)
+    return len(data)
+
+
+def shipped_bytes(token: str) -> int:
+    """Size of ``token``'s shipped artifact, or 0 when absent."""
+    path = _artifact_file(token)
+    try:
+        return os.path.getsize(path) if path else 0
+    except OSError:
+        return 0

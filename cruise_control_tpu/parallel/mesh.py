@@ -111,6 +111,38 @@ def distributed_optimize_goal(model: TensorClusterModel, spec: GoalSpec,
     return model, int(steps), int(total)
 
 
+def bucket_ladder(num_brokers: int) -> Tuple[int, ...]:
+    """The power-of-two frontier buckets a ``num_brokers`` cluster can
+    ever dispatch (the doubling ladder from ``_FRONTIER_DENSE_MIN`` up to
+    the dense fallback) — the shape family AOT prelowering compiles ahead
+    of a solve."""
+    from cruise_control_tpu.analyzer.optimizer import _FRONTIER_DENSE_MIN
+    out = []
+    b = _FRONTIER_DENSE_MIN
+    while b < num_brokers:
+        out.append(b)
+        b *= 2
+    return tuple(out)
+
+
+def prelower_goal_programs(model: TensorClusterModel, spec: GoalSpec,
+                           prev_specs: Tuple[GoalSpec, ...],
+                           constraint: BalancingConstraint,
+                           options: OptimizationOptions, mesh: Mesh,
+                           num_sources: int, num_dests: int,
+                           pipelined: bool = False,
+                           flight_capacity: int = 0):
+    """AOT-lower + ship one goal's whole chunk-program family (dense + the
+    full bucket ladder) over ``mesh`` ahead of the solve.  No-op unless
+    ``CRUISE_AOT_PRELOWER=1``; returns the per-bucket prelower records."""
+    from cruise_control_tpu.analyzer import optimizer as opt
+    buckets = (None,) + bucket_ladder(model.num_brokers)
+    return opt.prelower_bucket_family(
+        model, options, spec, prev_specs, constraint, num_sources, num_dests,
+        buckets=buckets, mesh=mesh, flight_capacity=flight_capacity,
+        pipelined=pipelined)
+
+
 def distributed_frontier_fixpoint(model: TensorClusterModel, spec: GoalSpec,
                                   prev_specs: Tuple[GoalSpec, ...],
                                   constraint: BalancingConstraint,
@@ -121,7 +153,8 @@ def distributed_frontier_fixpoint(model: TensorClusterModel, spec: GoalSpec,
                                   on_chunk=None, frontier: bool = True,
                                   speculate: Optional[bool] = None,
                                   seed_active=None, next_goal=None,
-                                  prelaunch=None):
+                                  prelaunch=None, min_chunk: int = 4,
+                                  prelower: bool = True):
     """Shrinking-frontier chunk driver under the device mesh: identical
     orchestration to ``optimizer.frontier_fixpoint`` (boundary stats and
     frontier mask piggybacked on each chunk's packed output, double-buffered
@@ -151,13 +184,44 @@ def distributed_frontier_fixpoint(model: TensorClusterModel, spec: GoalSpec,
     ``seed_active`` warm-seeds the first dispatch's frontier, and
     ``next_goal`` / ``prelaunch`` (a ``PipelineNextGoal`` descriptor and a
     handoff record from the previous goal's driver) enable the inter-goal
-    pipelining protocol — all passed through unchanged; the conflict gate
-    and opener dispatches lower through the same GSPMD path as every other
-    chunk."""
+    pipelining protocol; the conflict gate and opener dispatches lower
+    through the same GSPMD path as every other chunk.
+
+    With ``CRUISE_AOT_PRELOWER=1`` (and ``prelower`` left on) the driver
+    first AOT-lowers and ships the goal's whole (dense + bucket ladder)
+    program family for this mesh — every chunk the solve can dispatch then
+    runs a prelowered executable, and ``info["aot_prelowered"]`` records
+    the family.  ``info["mesh"]`` summarizes the per-shard dispatch
+    economy: device count, boundary bytes moved, and HLO collective counts
+    per dispatched program."""
     from cruise_control_tpu.analyzer.optimizer import frontier_fixpoint
-    return frontier_fixpoint(model, options, spec, prev_specs, constraint,
-                             num_sources=num_sources, num_dests=num_dests,
-                             max_steps=max_steps, chunk_steps=chunk_steps,
-                             mesh=mesh, frontier=frontier, on_chunk=on_chunk,
-                             speculate=speculate, seed_active=seed_active,
-                             next_goal=next_goal, prelaunch=prelaunch)
+    n = int(mesh.devices.size)
+    r = model.num_replicas_padded
+    if r % n != 0:
+        raise ValueError(
+            f"padded replica axis {r} not divisible by mesh size {n}")
+    pipelined = next_goal is not None or prelaunch is not None
+    prelowered = []
+    if prelower:
+        ns = num_sources or cgen.default_num_sources(model)
+        nd = num_dests or cgen.default_num_dests(model)
+        prelowered = prelower_goal_programs(
+            model, spec, prev_specs, constraint, options, mesh, ns, nd,
+            pipelined=pipelined) if frontier else []
+    model, info = frontier_fixpoint(
+        model, options, spec, prev_specs, constraint,
+        num_sources=num_sources, num_dests=num_dests,
+        max_steps=max_steps, chunk_steps=chunk_steps,
+        mesh=mesh, frontier=frontier, on_chunk=on_chunk,
+        speculate=speculate, seed_active=seed_active,
+        next_goal=next_goal, prelaunch=prelaunch, min_chunk=min_chunk)
+    if prelowered:
+        info["aot_prelowered"] = prelowered
+    info["mesh"] = {
+        "devices": n,
+        "fetch_bytes": sum(c.get("fetch_bytes", 0)
+                           for c in info.get("chunks", [])),
+        "collectives": sum(c.get("collectives") or 0
+                           for c in info.get("chunks", [])),
+    }
+    return model, info

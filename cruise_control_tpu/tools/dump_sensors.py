@@ -210,6 +210,33 @@ def exercise(api, mgr) -> None:
     opt.optimize(model, ["ReplicaDistributionGoal",
                          "LeaderReplicaDistributionGoal"],
                  raise_on_hard_failure=False, fused=True, pipeline=True)
+    # AOT prelower/shipping families: one flag-on pipelined pass
+    # (CRUISE_AOT_PRELOWER is part of every dispatch-cache key, so this
+    # pass AOT-lowers its own chunk executables ahead of dispatch and
+    # ships the serialized artifacts into a throwaway store) — registers
+    # GoalOptimizer.aot-prelowered / executables-shipped-bytes /
+    # aot-dispatches.  The per-shard dispatch-economy counters
+    # (boundary-fetch-bytes / mesh-collective-ops) register from the
+    # pipelined passes' boundary accounting.
+    import shutil
+    saved_aot = os.environ.get("CRUISE_AOT_PRELOWER")
+    saved_xdg = os.environ.get("XDG_CACHE_HOME")
+    tmp_store = tempfile.mkdtemp(prefix="cc_dump_sensors_aot_")
+    os.environ["CRUISE_AOT_PRELOWER"] = "1"
+    os.environ["XDG_CACHE_HOME"] = tmp_store
+    try:
+        opt.optimize(model, ["ReplicaDistributionGoal"],
+                     raise_on_hard_failure=False, fused=True, pipeline=True)
+    finally:
+        if saved_aot is None:
+            os.environ.pop("CRUISE_AOT_PRELOWER", None)
+        else:
+            os.environ["CRUISE_AOT_PRELOWER"] = saved_aot
+        if saved_xdg is None:
+            os.environ.pop("XDG_CACHE_HOME", None)
+        else:
+            os.environ["XDG_CACHE_HOME"] = saved_xdg
+        shutil.rmtree(tmp_store, ignore_errors=True)
     mgr.run_detectors_once(now_ms=1)
     # Heal pipeline: kill one broker and let the detector → notifier(FIX) →
     # facade chain run a self-healing remove.  The standing proposal from the

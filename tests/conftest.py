@@ -91,6 +91,8 @@ def _clear_jax_caches_between_modules():
     _opt._fixpoint_cache.clear()
     _opt._stack_cache.clear()
     _opt._budget_cache.clear()
-    _opt._gate_fn = None
+    _opt._gate_cache.clear()
     _opt._sweep_cache.clear()
+    _opt._aot_registry.clear()
+    _opt._aot_hlo.clear()
     jax.clear_caches()

@@ -1,0 +1,140 @@
+"""AOT executable prelowering + shipping (``CRUISE_AOT_PRELOWER``).
+
+The bucket-family chunk programs can be lowered and compiled AHEAD of the
+solve (``jax.jit(...).lower(args).compile()``) and their serialized
+executables persisted through ``common/compile_cache.py`` — so a tunneled
+runtime ships each (goal, bucket, mesh) shape once instead of
+re-serializing every fresh build over the control channel.  These tests
+pin the contract: flag off is a strict no-op, flag on changes NO proposal
+(bit-identity), prelowered registry entries are HIT by the live driver's
+dispatches, serialized artifacts land on disk, and the flag is part of
+every jit cache key (the cruise-lint cache-key rule's runtime twin).
+"""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import optimizer as opt
+from cruise_control_tpu.analyzer.balancing_constraint import BalancingConstraint
+from cruise_control_tpu.analyzer.goals.specs import GOAL_SPECS
+from cruise_control_tpu.analyzer.state import OptimizationOptions
+from cruise_control_tpu.common import compile_cache
+from cruise_control_tpu.model.generator import ClusterSpec, generate_cluster
+
+
+@pytest.fixture(scope="module")
+def model():
+    spec = ClusterSpec(num_brokers=8, num_racks=4, num_topics=3,
+                       mean_partitions_per_topic=12.0, replication_factor=2,
+                       distribution="exponential", seed=11)
+    return generate_cluster(spec, pad_replicas_to_multiple=8)
+
+
+NS, ND = 8, 4
+
+
+def _fixpoint(model, **kw):
+    con = BalancingConstraint.default()
+    options = OptimizationOptions.none(model)
+    g = GOAL_SPECS["ReplicaDistributionGoal"]
+    return opt.frontier_fixpoint(model, options, g, (), con,
+                                 num_sources=NS, num_dests=ND,
+                                 max_steps=16, chunk_steps=8, **kw)
+
+
+def test_flag_off_is_noop(model, monkeypatch):
+    """Without CRUISE_AOT_PRELOWER=1 nothing is lowered, nothing shipped:
+    prelower_bucket_family returns [] and the dispatch path never touches
+    the AOT counters."""
+    monkeypatch.delenv("CRUISE_AOT_PRELOWER", raising=False)
+    con = BalancingConstraint.default()
+    options = OptimizationOptions.none(model)
+    g = GOAL_SPECS["ReplicaDistributionGoal"]
+    before = dict(opt.AOT_COUNTERS)
+    assert opt.prelower_bucket_family(model, options, g, (), con,
+                                      NS, ND) == []
+    _fixpoint(model)
+    assert opt.AOT_COUNTERS == before
+
+
+def test_aot_dispatch_is_bit_identical_and_hits_registry(
+        model, monkeypatch, tmp_path):
+    """Flag on: the prelowered dense executable serves the live driver's
+    dispatches (registry HIT — no second lowering of the same shape), the
+    serialized artifact is shipped to the store, and the proposals are
+    bit-identical to the flag-off run."""
+    monkeypatch.delenv("CRUISE_AOT_PRELOWER", raising=False)
+    ref_model, ref = _fixpoint(model)
+
+    monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path))
+    monkeypatch.setenv("CRUISE_AOT_PRELOWER", "1")
+    con = BalancingConstraint.default()
+    options = OptimizationOptions.none(model)
+    g = GOAL_SPECS["ReplicaDistributionGoal"]
+    before = dict(opt.AOT_COUNTERS)
+    recs = opt.prelower_bucket_family(model, options, g, (), con, NS, ND,
+                                      buckets=(None,))
+    assert [r["bucket"] for r in recs] == [None]
+    assert opt.AOT_COUNTERS["prelowered"] == before["prelowered"] + 1
+    assert opt.AOT_COUNTERS["shipped_bytes"] > before["shipped_bytes"]
+
+    mid = dict(opt.AOT_COUNTERS)
+    got_model, got = _fixpoint(model)
+    # Every dispatch was served AOT from the SAME prelowered executable:
+    # no new lowering, no fallback to the jit path.
+    assert opt.AOT_COUNTERS["prelowered"] == mid["prelowered"]
+    assert opt.AOT_COUNTERS["aot_dispatches"] > mid["aot_dispatches"]
+    assert opt.AOT_COUNTERS["aot_fallbacks"] == mid["aot_fallbacks"]
+
+    assert (ref["steps"], ref["actions"], ref["satisfied_after"]) == \
+        (got["steps"], got["actions"], got["satisfied_after"])
+    np.testing.assert_array_equal(np.asarray(ref_model.replica_broker),
+                                  np.asarray(got_model.replica_broker))
+    np.testing.assert_array_equal(np.asarray(ref_model.replica_is_leader),
+                                  np.asarray(got_model.replica_is_leader))
+
+    # The serialized executable landed in the artifact store (idempotent:
+    # shipping the same token again writes nothing).
+    shipped = glob.glob(os.path.join(str(tmp_path), "**", "aot", "*.aotx"),
+                        recursive=True)
+    assert shipped, "no serialized executable in the artifact store"
+    assert all(os.path.getsize(p) > 0 for p in shipped)
+
+
+def test_prelower_flag_is_in_every_jit_cache_key(model):
+    """The env flag participates in the dispatch-cache keys, so flipping
+    it mid-process can never serve a stale closure (the runtime twin of
+    cruise-lint's cache-key rule)."""
+    con = BalancingConstraint.default()
+    g = GOAL_SPECS["ReplicaDistributionGoal"]
+    os.environ.pop("CRUISE_AOT_PRELOWER", None)
+    fn_off = opt._get_budget_fixpoint_fn(g, (), con, NS, ND)
+    os.environ["CRUISE_AOT_PRELOWER"] = "1"
+    try:
+        fn_on = opt._get_budget_fixpoint_fn(g, (), con, NS, ND)
+    finally:
+        os.environ.pop("CRUISE_AOT_PRELOWER", None)
+    assert fn_off is not fn_on
+    assert opt._get_budget_fixpoint_fn(g, (), con, NS, ND) is fn_off
+
+
+def test_ship_executable_idempotent(tmp_path, monkeypatch):
+    """ship_executable serializes once per token: the second call is a
+    HIT that writes zero bytes, and shipped_bytes() reads the artifact's
+    on-disk size back."""
+    monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path))
+    fn = jax.jit(lambda x: x * 2 + 1)
+    compiled = fn.lower(jnp.arange(8, dtype=jnp.float32)).compile()
+    token = compile_cache.program_token("aot-test", ("k",), ((8,), "f32"))
+    before = dict(compile_cache.SHIP_COUNTERS)
+    n = compile_cache.ship_executable(token, compiled)
+    assert n > 0
+    assert compile_cache.SHIP_COUNTERS["shipped"] == before["shipped"] + 1
+    assert compile_cache.ship_executable(token, compiled) == 0
+    assert compile_cache.SHIP_COUNTERS["hits"] == before["hits"] + 1
+    assert compile_cache.shipped_bytes(token) == n

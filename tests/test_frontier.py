@@ -588,6 +588,43 @@ def test_bench_sla_survives_timeout_kill(tmp_path):
     assert rec["error"].startswith("killed_by_signal")
 
 
+def test_bench_mesh_survives_timeout_kill(tmp_path):
+    """Same kill-signal regression for the GSPMD parity twin rung: wedged
+    ``bench.py --mesh`` must still exit 0 with one parseable final line
+    whose headline is the mesh-flavored rung.  (The wedge fires in the
+    parent, before the 8-device child subprocess would spawn — the child
+    is budgeted by the parent's rung watchdog, so the parent's kill path
+    is the one that must stay signal-safe.)"""
+    import json
+    import signal as _signal
+    import subprocess
+
+    partial = tmp_path / "partial.jsonl"
+    env = dict(os.environ, BENCH_SELFTEST_WEDGE="1",
+               BENCH_PARTIAL_PATH=str(partial),
+               BENCH_TOTAL_BUDGET_S="120")
+    env.pop("BENCH_T0", None)
+    env.pop("BENCH_MESH_CHILD", None)
+    proc = subprocess.Popen(
+        [sys.executable, str(Path(__file__).resolve().parent.parent
+                             / "bench.py"), "--mesh", "--rungs", "small"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not partial.exists():
+            time.sleep(0.05)
+        assert partial.exists(), "bench never flushed its partial record"
+        proc.send_signal(_signal.SIGTERM)
+        out, _ = proc.communicate(timeout=30)
+    finally:
+        proc.kill()
+    assert proc.returncode == 0
+    rec = json.loads(out.decode().strip().splitlines()[-1])
+    assert rec["metric"] == "mesh_stack_parity_small"
+    assert rec["mesh"] is True
+    assert rec["error"].startswith("killed_by_signal")
+
+
 def test_tail_report_summary():
     from tools.tail_report import tail_summary
 

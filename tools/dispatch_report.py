@@ -9,6 +9,12 @@ then the record-level ``dispatch`` counters.  A goal whose fetch count
 exceeds its chunk count means a probe crept back into the boundary path;
 the row is flagged.
 
+Mesh records (MESH_*.json / SHARDED_*) add the per-shard dispatch-economy
+columns: ``bytes`` (host-bound bytes moved over the search-axis boundary
+per chunk fetch, summed) and ``coll`` (cross-device collectives counted in
+the dispatched programs' lowered HLO — populated on AOT runs, where the
+compiled text is in hand).
+
 Audit mode (``--audit``): run the mid bench rung (or ``--rung``) on the
 current backend with ``jax.device_get`` wrapped by a counter, and emit a
 JSON line pinning the measured host-fetch budget: total ``device_get``
@@ -60,6 +66,13 @@ def goal_rows(record: dict) -> list:
             "pipelined": bool(g.get("pipelined", False)),
             "fetch_wait_s": float(g.get("fetch_wait_s", 0.0)),
             "wall_s": float(g.get("wall_s", 0.0)),
+            # Per-shard dispatch economy (mesh/AOT records; 0 elsewhere):
+            # bytes fetched hostward at this goal's chunk boundaries and
+            # collectives in its dispatched HLO.
+            "fetch_bytes": sum(int(c.get("fetch_bytes", 0) or 0)
+                               for c in chunks),
+            "collectives": sum(int(c.get("collectives") or 0)
+                               for c in chunks),
             "probe_leak": bool(chunks) and fetches > len(chunks),
         })
     return rows
@@ -77,6 +90,8 @@ def report(record: dict) -> dict:
         "total_chunks_cross_goal": sum(r["chunks_cross_goal"] for r in rows),
         "total_chunks_cross_wasted": sum(r["chunks_cross_wasted"]
                                          for r in rows),
+        "total_fetch_bytes": sum(r["fetch_bytes"] for r in rows),
+        "total_collectives": sum(r["collectives"] for r in rows),
         # Wall reclaimed by cross-goal overlap: the summed magnitude of the
         # negative boundary gaps (goals whose first chunk was in flight
         # before their predecessor finished).
@@ -91,9 +106,10 @@ def report(record: dict) -> dict:
 def print_table(rep: dict) -> None:
     cols = ("goal", "fetches", "chunks", "chunks_speculative",
             "chunks_wasted", "chunks_cross_goal", "chunks_cross_wasted",
-            "boundary_gap_s", "fetch_wait_s", "wall_s")
+            "boundary_gap_s", "fetch_wait_s", "wall_s", "fetch_bytes",
+            "collectives")
     head = ("goal", "fetches", "chunks", "spec", "wasted", "cross",
-            "xwaste", "gap_s", "boundary_s", "wall_s")
+            "xwaste", "gap_s", "boundary_s", "wall_s", "bytes", "coll")
     rows = [[str(r[c]) if c == "goal"
              else (f"{r[c]:.3f}" if isinstance(r[c], float) else str(r[c]))
              for c in cols] + (["PROBE-LEAK"] if r["probe_leak"] else [""])
@@ -109,7 +125,9 @@ def print_table(rep: dict) -> None:
           f"boundary_wait={rep['total_fetch_wait_s']}s "
           f"cross={rep['total_chunks_cross_goal']} "
           f"cross_wasted={rep['total_chunks_cross_wasted']} "
-          f"overlap={rep['overlap_wall_s']}s")
+          f"overlap={rep['overlap_wall_s']}s "
+          f"bytes={rep['total_fetch_bytes']} "
+          f"collectives={rep['total_collectives']}")
     if "dispatch" in rep:
         print(f"dispatch counters: {json.dumps(rep['dispatch'])}")
 

@@ -168,6 +168,38 @@ CONTRACTS: Tuple[Contract, ...] = (
         op="==", bound=0,
         why="the (broker × resource × window) scorer is branch-free "
             "masked reductions by construction (PR 10)"),
+    Contract(
+        id="sharded-chunk-callback-free",
+        program="sharded_chunk", metric="callback_primitives",
+        op="==", bound=0,
+        why="the GSPMD chunk program runs on every device of the search "
+            "mesh; one host callback would serialize the whole mesh on "
+            "every step"),
+    Contract(
+        id="sharded-chunk-fetch-budget",
+        program="sharded_chunk", metric="boundary_fetch_excess",
+        op="<=", bound=0,
+        why="the sharded driver's contract is ≤1 blocking fetch per chunk "
+            "boundary — every boundary decision input (packed stats, "
+            "frontier mask, touched accumulator) piggybacks on the chunk's "
+            "own outputs, never a separate probe dispatch ('Scale limits', "
+            "docs/DESIGN_ANALYZER.md)"),
+    Contract(
+        id="sharded-frontier-shard-operand",
+        program="sharded_chunk", metric="frontier_shard_operand",
+        op="==", bound=1,
+        why="a compacted bucket dispatched under a mesh must carry the "
+            "per-shard frontier mask (FrontierInvariants.shard_active) so "
+            "each device owns its slice of the bucket instead of a "
+            "replicated copy"),
+    Contract(
+        id="sharded-widths-lane-aligned",
+        program="sharded_chunk", metric="width_lane_remainder",
+        op="==", bound=0,
+        why="_frontier_widths must round compacted candidate widths up to "
+            "mesh-lane multiples — a ragged shard breaks the one-"
+            "executable-per-(goal, bucket, mesh) reuse and the sharded-vs-"
+            "single-device bit-identity gate (bench.py --mesh)"),
 )
 
 
@@ -197,6 +229,14 @@ FETCH_SITES: Tuple[Tuple[str, str], ...] = (
     ("cruise_control_tpu/detector/device.py", "DeviceScorer.scores"),
     ("cruise_control_tpu/detector/device.py",
      "DeviceGoalViolationDetector"),
+    # Sharded chunk driver: drives frontier_fixpoint under the device mesh
+    # and owns the same ≤1-fetch-per-boundary budget (FETCH_COUNTERS).
+    ("cruise_control_tpu/parallel/mesh.py", "distributed_frontier_fixpoint"),
+    # AOT prelower/ship path: lowers and serializes the bucket-family
+    # executables strictly BEFORE the solve — host-side by design, never a
+    # mid-chunk sync; accounting lives in AOT_COUNTERS / SHIP_COUNTERS.
+    ("cruise_control_tpu/analyzer/optimizer.py", "prelower_bucket_family"),
+    ("cruise_control_tpu/common/compile_cache.py", "ship_executable"),
     # Post-run host conversions — never inside a solve.
     ("cruise_control_tpu/model/stats.py", "ClusterModelStats.to_dict"),
     ("cruise_control_tpu/analyzer/proposals.py", "diff"),

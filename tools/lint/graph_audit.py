@@ -4,7 +4,9 @@ The AST layer reasons about source; this layer reasons about the actual
 compiled artifacts.  It traces every program named by a
 :class:`~tools.lint.contracts.Contract` — the per-goal step fixpoint, the
 flight-recorder budget fixpoint, the fused multi-goal ``_stack_fixpoint``,
-the fused satisfied sweep, and the detector's ``DeviceScorer`` program —
+the fused satisfied sweep, the detector's ``DeviceScorer`` program, and
+the GSPMD sharded compacted chunk (``_goal_fixpoint_budget`` under a
+search mesh with per-shard frontier invariants) —
 on the same tiny fixture the tier-1 budget test uses (equation counts are
 shape-independent, see tools/step_graph_report.py), then evaluates the
 declarative contract table against the measured jaxprs.
@@ -208,12 +210,92 @@ def _audit_device_scorer(fx: _Fixture) -> Dict[str, int]:
     }
 
 
+def _audit_sharded_chunk(fx: _Fixture) -> Dict[str, int]:
+    """The sharded compacted chunk: ``_goal_fixpoint_budget`` traced under
+    GSPMD with a compacted :class:`FrontierInvariants` carrying the
+    per-shard frontier mask, plus one LIVE tiny sharded fixpoint to pin
+    the driver's ≤1-blocking-fetch-per-boundary budget.
+
+    The mesh spans the largest power-of-two device count that divides the
+    fixture's padded replica axis — on a plain ``python -m tools.lint``
+    run that is a 1-device mesh, which still commits NamedShardings and
+    exercises the compacted widths, trace, and live fetch budget.  The
+    per-shard frontier operand is deliberately None on a 1-device mesh
+    (single-device graphs stay byte-identical to pre-mesh builds), so
+    that one metric passes vacuously there and bites under the 8-device
+    harness (tests/conftest.py forces 8 virtual CPU devices)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from functools import partial
+
+    from cruise_control_tpu.parallel import mesh as pmesh
+    from tools.step_graph_report import count_equations, count_primitive
+
+    opt = fx.opt
+    n = 1
+    while (n * 2 <= len(jax.devices())
+           and fx.model.num_replicas_padded % (n * 2) == 0):
+        n *= 2
+    mesh = pmesh.make_search_mesh(n)
+    sharded = pmesh.shard_model_replica_axis(fx.model, mesh)
+
+    bucket = max(8, n)  # pow2, divides any pow2 mesh ≤ its size
+    B = fx.model.num_brokers
+    active = np.zeros((B,), dtype=bool)
+    active[: min(4, B)] = True
+    fr = opt._build_frontier(active, bucket, mesh)
+    cns, cnd = opt._frontier_widths(bucket, fx.ns, fx.nd, lanes=n)
+
+    fix = partial(opt._goal_fixpoint_budget, spec=fx.goal,
+                  prev_specs=fx.prev_specs, constraint=fx.constraint,
+                  num_sources=cns, num_dests=cnd, mesh=mesh,
+                  repair_oracle=fx.repair_oracle)
+    blank = jnp.zeros((B,), dtype=bool)
+    jaxpr = jax.make_jaxpr(fix)(sharded, fx.options, jnp.int32(8), fr,
+                                blank, blank).jaxpr
+
+    # Live fetch budget: drive the real chunked fixpoint over the sharded
+    # model with the dense floor lowered so compaction engages at audit
+    # shape, then compare the FETCH_COUNTERS delta against dispatched
+    # chunks.  Speculative chunks ride their predecessor's fetch, so the
+    # excess may go negative — the contract only forbids EXTRA fetches.
+    dense_min = opt._FRONTIER_DENSE_MIN
+    before = dict(opt.FETCH_COUNTERS)
+    opt._FRONTIER_DENSE_MIN = max(4, n)
+    try:
+        opt.frontier_fixpoint(sharded, fx.options, fx.goal, fx.prev_specs,
+                              fx.constraint, num_sources=fx.ns,
+                              num_dests=fx.nd, max_steps=32, chunk_steps=4,
+                              min_chunk=1, mesh=mesh)
+    finally:
+        opt._FRONTIER_DENSE_MIN = dense_min
+    fetches = opt.FETCH_COUNTERS["device_fetches"] - before["device_fetches"]
+    chunks = (opt.FETCH_COUNTERS["chunks_dispatched"]
+              - before["chunks_dispatched"])
+    return {
+        "mesh_devices": n,
+        "bucket": bucket,
+        "compact_num_sources": cns,
+        "compact_num_dests": cnd,
+        "width_lane_remainder": (cns % n) + (cnd % n),
+        "frontier_shard_operand": int(fr.shard_active is not None or n == 1),
+        "equations": count_equations(jaxpr),
+        "while_primitives": count_primitive(jaxpr, "while"),
+        "callback_primitives": _count_callbacks(jaxpr),
+        "live_fetches": fetches,
+        "live_chunks": chunks,
+        "boundary_fetch_excess": fetches - chunks,
+    }
+
+
 PROGRAMS = {
     "step_fixpoint": _audit_step_fixpoint,
     "flight_overhead": _audit_flight_overhead,
     "stack_fixpoint": _audit_stack_fixpoint,
     "satisfied_sweep": _audit_satisfied_sweep,
     "device_scorer": _audit_device_scorer,
+    "sharded_chunk": _audit_sharded_chunk,
 }
 
 

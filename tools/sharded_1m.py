@@ -6,7 +6,9 @@ goal stack through mesh-sharded device-resident fixpoints to an actual
 goal-satisfying proposal set — the long-axis scaling recipe (replica axis
 of the model + K axis of the candidate batch partitioned over devices;
 broker aggregates reduce via XLA-inserted collectives).  Writes
-``SHARDED_1M_r04.json`` with wall clock, per-goal steps/actions, and the
+``SHARDED_1M_r07.json`` (the ``SHARDED_OUT`` default, shared with the
+round-5+ successor ``sharded_fixpoint.py`` so both tools target the
+current rung's artifact) with wall clock, per-goal steps/actions, and the
 proposal count.
 
 Usage:
@@ -136,7 +138,7 @@ def main():
     }
     out_path = os.environ.get("SHARDED_OUT", os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "SHARDED_1M_r04.json"))
+        "SHARDED_1M_r07.json"))
     with open(out_path, "w") as f:
         f.write(json.dumps(record) + "\n")
     print(json.dumps(record), flush=True)

@@ -10,6 +10,15 @@ disk so a multi-hour virtual-CPU-mesh run survives interruption and
 resumes goal- and chunk-exactly.  Each chunk's accepted-action count is
 recorded, giving the actions/step decay curve per goal.
 
+Round 7 adds ``FIXPOINT_PIPELINE=1``: instead of the checkpointed
+per-goal chunk loop (whose ``on_chunk`` callback disables speculative
+dispatch — the round-5 wall-clock ceiling), the whole stack runs through
+``optimize(fused=True, pipeline=True, mesh=...)`` — mesh-sharded
+compaction buckets, double-buffered speculation, inter-goal openers —
+followed by a warm re-solve seeded from the converged placement.  Set
+``CRUISE_AOT_PRELOWER=1`` to ship each goal's executable family through
+the AOT artifact store ahead of its dispatches.
+
 Usage:
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python tools/sharded_fixpoint.py
@@ -20,8 +29,11 @@ Environment:
                         on: band goals run optimizer.frontier_fixpoint —
                         per-chunk frontier compaction + adaptive chunk
                         length — with the same checkpoint cadence)
+    FIXPOINT_PIPELINE   "1" runs the round-7 pipelined drive (above)
     FIXPOINT_STATE      checkpoint dir (default <repo>/.fixpoint_state)
-    SHARDED_OUT         final record path (default SHARDED_1M_r05.json)
+    SHARDED_OUT         final record path (default SHARDED_1M_r07.json,
+                        shared with sharded_1m.py; the mid-run partial
+                        record derives from it as <out>.partial.json)
     SHARDED_GOALS / SHARDED_NS / SHARDED_ND as in sharded_1m.py
 """
 import json
@@ -64,9 +76,9 @@ def main():
     os.makedirs(state_dir, exist_ok=True)
     ckpt_path = os.path.join(state_dir, "model.npz")
     prog_path = os.path.join(state_dir, "progress.json")
-    partial_path = os.path.join(REPO, "SHARDED_1M_r05.partial.json")
     out_path = os.environ.get("SHARDED_OUT",
-                              os.path.join(REPO, "SHARDED_1M_r05.json"))
+                              os.path.join(REPO, "SHARDED_1M_r07.json"))
+    partial_path = os.path.splitext(out_path)[0] + ".partial.json"
 
     devs = jax.devices()
     n = len(devs)
@@ -113,6 +125,95 @@ def main():
     print(f"stack={len(goal_names)} goals ns={ns} nd={nd} "
           f"chunk={chunk} max_chunks={max_chunks} frontier={use_frontier}",
           flush=True)
+
+    if os.environ.get("FIXPOINT_PIPELINE", "0").strip() == "1":
+        # Round-7 drive: no on_chunk checkpointing (the callback forces
+        # speculation off), the pipelined per-goal frontier path instead —
+        # and a warm re-solve from the converged placement, the cruise-mode
+        # cadence the facade's replanner runs.
+        from cruise_control_tpu.analyzer.state import WarmStart
+        budget = chunk * max_chunks
+        t0 = time.monotonic()
+        run = opt.optimize(model, goal_names, constraint=constraint,
+                           options=options, max_steps_per_goal=budget,
+                           num_sources=ns, num_dests=nd,
+                           raise_on_hard_failure=False,
+                           fused=True, pipeline=True, mesh=mesh)
+        jax.block_until_ready(run.model.replica_broker)
+        cold_wall = time.monotonic() - t0
+        print(f"cold pipelined solve: {cold_wall:.0f}s "
+              f"(overlapped={run.goals_overlapped} fused={run.goals_fused} "
+              f"skipped={run.goals_skipped})", flush=True)
+        t0 = time.monotonic()
+        wrun = opt.optimize(model, goal_names, constraint=constraint,
+                            options=options, max_steps_per_goal=budget,
+                            num_sources=ns, num_dests=nd,
+                            raise_on_hard_failure=False,
+                            fused=True, pipeline=True, mesh=mesh,
+                            warm_start=WarmStart(prev_model=run.model))
+        jax.block_until_ready(wrun.model.replica_broker)
+        warm_wall = time.monotonic() - t0
+        print(f"warm re-solve: {warm_wall:.0f}s "
+              f"(warm={wrun.warm} skipped={wrun.goals_skipped})", flush=True)
+
+        t0 = time.monotonic()
+        proposals = props.diff(model0, run.model)
+        diff_s = time.monotonic() - t0
+        run.model.sanity_check()
+        rf0 = np.asarray(model0.partition_replication_factor())
+        rf1 = np.asarray(run.model.partition_replication_factor())
+        assert (rf0 == rf1).all(), "replication factor changed"
+        assert (np.asarray(model0.replica_valid)
+                == np.asarray(run.model.replica_valid)).all(), \
+            "valid mask changed"
+
+        per_goal = {g.name: {
+            "steps": g.steps, "actions": g.actions_applied,
+            "satisfied_before": g.satisfied_before,
+            "satisfied_after": g.satisfied_after,
+            "capped": g.capped, "wall_s": round(g.duration_s, 1),
+            "chunks": len(g.chunks or ()),
+            "chunks_speculative": g.chunks_speculative,
+            "chunks_cross_goal": g.chunks_cross_goal,
+            "fused_group": g.fused_group,
+            "pipelined": g.pipelined,
+        } for g in run.goal_results}
+        hard = {g.name for g in goals_by_priority(goal_names) if g.is_hard}
+        hard_ok = all(g.satisfied_after for g in run.goal_results
+                      if g.name in hard)
+        baseline_r05 = 9600.0
+        record = {
+            "metric": "sharded_1m_pipelined",
+            "round": 7,
+            "num_replicas": num_replicas,
+            "num_brokers": nb,
+            "devices": n,
+            "backend": devs[0].platform,
+            "goals": goal_names,
+            "ns": ns, "nd": nd,
+            "max_steps_per_goal": budget,
+            "optimize_wall_s": round(cold_wall, 1),
+            "warm_resolve_wall_s": round(warm_wall, 1),
+            "baseline_r05_wall_s": baseline_r05,
+            "speedup_vs_r05": round(baseline_r05 / max(cold_wall, 1e-9), 2),
+            "proposal_diff_s": round(diff_s, 1),
+            "total_steps": sum(g.steps for g in run.goal_results),
+            "num_proposals": len(proposals),
+            "hard_goals_satisfied": bool(hard_ok),
+            "uncapped": all(not g.capped for g in run.goal_results),
+            "invariants_verified": True,
+            "goals_overlapped": run.goals_overlapped,
+            "goals_fused": run.goals_fused,
+            "warm_goals_skipped": wrun.goals_skipped,
+            "aot_prelower": bool(opt._aot_prelower()),
+            "aot": dict(opt.AOT_COUNTERS),
+            "per_goal": per_goal,
+        }
+        with open(out_path, "w") as f:
+            f.write(json.dumps(record) + "\n")
+        print(json.dumps({k: v for k, v in record.items()
+                          if k != "per_goal"}), flush=True)
+        return
 
     def save_state(elapsed):
         np.savez(ckpt_path + ".tmp.npz",

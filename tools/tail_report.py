@@ -19,7 +19,9 @@ The report also derives each goal's **wall slope** — max/min per-step wall
 over chunks of the same compiled shape (bucket, ns, nd) — the flatness
 signature of the bounded-depth repair: with a fixed-trip step graph the
 per-step wall should not depend on how close the state sits to a band
-edge (see ``wall_slope``).
+edge (see ``wall_slope``).  Mesh records additionally report per-shard
+dispatch economy: ``bytes`` (hostward bytes at chunk-boundary fetches)
+and ``coll`` (HLO collectives in the dispatched programs, AOT runs).
 
 Usage:
     python tools/tail_report.py SHARDED_1M_r05.json [--tail-frac 0.1] [--json]
@@ -92,11 +94,19 @@ def goal_summary(name: str, g: dict, tail_frac: float) -> dict:
         rec["wall_slope"] = wall_slope(chunks)
         rec["repair_steps"] = sum(int(c.get("repair_steps", 0))
                                   for c in chunks)
+        # Per-shard dispatch economy (mesh/AOT records; 0 on single-chip
+        # records): bytes moved hostward over the search-axis boundary at
+        # this goal's chunk fetches, and collectives in its dispatched HLO.
+        rec["fetch_bytes"] = sum(int(c.get("fetch_bytes", 0) or 0)
+                                 for c in chunks)
+        rec["collectives"] = sum(int(c.get("collectives") or 0)
+                                 for c in chunks)
     else:
         rec.update({"num_chunks": 0, "peak_actions_per_step": None,
                     "tail_chunks": 0, "tail_wall_s": 0.0,
                     "tail_fraction": None, "wall_slope": None,
-                    "repair_steps": g.get("repair_steps", 0)})
+                    "repair_steps": g.get("repair_steps", 0),
+                    "fetch_bytes": 0, "collectives": 0})
     return rec
 
 
@@ -124,6 +134,8 @@ def tail_summary(record: dict, tail_frac: float = 0.1) -> dict:
         # tail drained (0.0 for non-pipelined records).
         "overlap_wall_s": round(-sum(g["boundary_gap_s"] for g in goals
                                      if g["boundary_gap_s"] < 0), 3),
+        "total_fetch_bytes": sum(g.get("fetch_bytes", 0) for g in goals),
+        "total_collectives": sum(g.get("collectives", 0) for g in goals),
     }
 
 
@@ -148,7 +160,7 @@ def main(argv: Optional[list] = None) -> None:
         return
     print(f"{'goal':<40} {'steps':>6} {'actions':>8} {'wall_s':>8} "
           f"{'chunks':>6} {'tail_s':>8} {'tail%':>6} {'slope':>6} "
-          f"{'gap_s':>8}")
+          f"{'gap_s':>8} {'bytes':>10} {'coll':>5}")
     for g in rep["goals"]:
         tf = (f"{100 * g['tail_fraction']:.0f}%"
               if g["tail_fraction"] is not None else "-")
@@ -156,18 +168,24 @@ def main(argv: Optional[list] = None) -> None:
               if g.get("wall_slope") is not None else "-")
         gap = (f"{g['boundary_gap_s']:+.3f}"
                if g.get("boundary_gap_s") else "-")
+        fb = g.get("fetch_bytes", 0)
+        co = g.get("collectives", 0)
         print(f"{g['goal']:<40} {g['steps']:>6} {g['actions']:>8} "
               f"{g['wall_s']:>8.1f} {g['num_chunks']:>6} "
-              f"{g['tail_wall_s']:>8.1f} {tf:>6} {sl:>6} {gap:>8}")
+              f"{g['tail_wall_s']:>8.1f} {tf:>6} {sl:>6} {gap:>8} "
+              f"{fb if fb else '-':>10} {co if co else '-':>5}")
     tf = (f"{100 * rep['tail_fraction']:.0f}%"
           if rep["tail_fraction"] is not None else "-")
     sl = (f"{rep['wall_slope']:.2f}"
           if rep.get("wall_slope") is not None else "-")
     ov = (f"-{rep['overlap_wall_s']:.3f}"
           if rep.get("overlap_wall_s") else "-")
+    tb = rep.get("total_fetch_bytes", 0)
+    tc = rep.get("total_collectives", 0)
     print(f"{'TOTAL (goals with chunk data)':<40} {'':>6} {'':>8} "
           f"{rep['total_wall_s']:>8.1f} {'':>6} {rep['tail_wall_s']:>8.1f} "
-          f"{tf:>6} {sl:>6} {ov:>8}")
+          f"{tf:>6} {sl:>6} {ov:>8} {tb if tb else '-':>10} "
+          f"{tc if tc else '-':>5}")
 
 
 if __name__ == "__main__":
